@@ -1,0 +1,6 @@
+# Root conftest: makes the in-tree package importable when running
+# `python -m pytest tests/` without an editable install.
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))

@@ -1,0 +1,31 @@
+"""ddl25spring_tpu — a TPU-native distributed deep learning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the course lab
+``mattduerrmeier/DDL25Spring`` (distributed training from primitives +
+federated learning), built TPU-first:
+
+- the reference's N OS processes + gloo send/recv/all_reduce become ONE
+  jitted SPMD program over a ``jax.sharding.Mesh`` (reference comm backend:
+  ``lab/s01_b1_microbatches.py:19``, ``lab/tutorial_1b/README.md:71``);
+- process groups become mesh axes, isend/irecv chains become XLA-scheduled
+  ``ppermute`` inside a scanned microbatch pipeline, flatten/all_reduce/
+  unflatten becomes ``jax.lax.psum`` on the gradient pytree;
+- federated clients become a vmapped axis with explicit PRNG threading.
+
+Subpackages
+-----------
+- ``utils``    mesh construction, PRNG discipline, metrics, config
+- ``data``     seeded data pipelines (MNIST-like, heart tabular, CIFAR-10,
+               TinyStories-like token streams) with offline-safe synthesis
+- ``models``   MnistCnn, HeartDiseaseNN, VAE, split-NN, LLaMA, ResNet-18
+- ``ops``      losses and (pallas) kernels
+- ``parallel`` DP, pipeline (GPipe microbatch), DPxPP on 2-D meshes
+- ``fl``       horizontal (FedSGD/FedAvg), vertical (split-NN), generative FL
+"""
+
+from ddl25spring_tpu.utils.mesh import make_mesh
+from ddl25spring_tpu.utils.metrics import RunResult
+
+__version__ = "0.1.0"
+
+__all__ = ["make_mesh", "RunResult", "__version__"]

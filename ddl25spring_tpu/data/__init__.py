@@ -1,0 +1,4 @@
+from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.data.splitter import split_indices
+
+__all__ = ["load_mnist", "split_indices"]

@@ -1,0 +1,4 @@
+from ddl25spring_tpu.models.mnist_cnn import MnistCnn
+from ddl25spring_tpu.models.heart_mlp import HeartDiseaseNN
+
+__all__ = ["MnistCnn", "HeartDiseaseNN"]

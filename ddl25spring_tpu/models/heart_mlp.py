@@ -1,0 +1,24 @@
+"""Heart-disease MLP.
+
+Capability parity with ``HeartDiseaseNN``
+(``lab/tutorial_2a/centralized.py:13-28``): 30 -> 64 -> 128 -> 256 -> 2 with
+ReLU between layers, raw logits out (trained with cross-entropy).  Doubles as
+the evaluator model for the TSTR harness
+(``lab/tutorial_2a/generative-modeling.py:164-208``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+
+class HeartDiseaseNN(nn.Module):
+    hidden: tuple[int, ...] = (64, 128, 256)
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_classes)(x)

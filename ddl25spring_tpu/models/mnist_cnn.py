@@ -1,0 +1,32 @@
+"""MNIST CNN.
+
+Capability parity with the reference's ``MnistCnn``
+(``lab/tutorial_1a/hfl_complete.py:39-64``): conv(1->32,3x3) -> relu ->
+conv(32->64,3x3) -> relu -> maxpool2 -> dropout(.25) -> flatten -> fc(9216,128)
+-> relu -> dropout(.5) -> fc(128,10) -> log_softmax.
+
+TPU-first notes: NHWC layout (XLA:TPU's native conv layout), dropout driven by
+an explicit flax RNG so client updates vmap cleanly in the federated layer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+
+class MnistCnn(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = False) -> jax.Array:
+        # x: [B, 28, 28, 1] NHWC
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))  # [B, 12*12*64] = [B, 9216]
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return nn.log_softmax(x)

@@ -1,0 +1,7 @@
+from ddl25spring_tpu.ops.losses import (
+    causal_lm_loss,
+    cross_entropy_logits,
+    nll_loss,
+)
+
+__all__ = ["causal_lm_loss", "cross_entropy_logits", "nll_loss"]

@@ -1,0 +1,77 @@
+"""Loss functions.
+
+Covers the reference's loss surface: ``F.nll_loss`` on log-softmax outputs
+(``lab/tutorial_1a/hfl_complete.py:77``), ``CrossEntropyLoss``
+(``lab/tutorial_2b/vfl.py:79``), simplellm's ``causalLLMLoss``
+(``lab/s01_b1_microbatches.py:8``), and the VAE's summed-MSE + KLD
+(``lab/tutorial_2a/generative-modeling.py:118-127``).
+
+All are computed in fp32 regardless of activation dtype — softmax/log-sum-exp
+in bf16 loses too much precision on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def nll_loss(log_probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean negative log-likelihood of integer labels under log-probs
+    (parity with ``F.nll_loss`` on ``MnistCnn``'s log_softmax output)."""
+    lp = log_probs.astype(jnp.float32)
+    picked = jnp.take_along_axis(lp, labels[:, None], axis=-1)[:, 0]
+    return -picked.mean()
+
+
+def cross_entropy_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy from raw logits (``nn.CrossEntropyLoss``)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return (logz - picked).mean()
+
+
+def causal_lm_loss(
+    logits: jax.Array,
+    tokens: jax.Array,
+    pad_id: int | None = None,
+) -> jax.Array:
+    """Next-token cross-entropy: logits at position t predict token t+1.
+
+    Parity with simplellm's ``causalLLMLoss(logits, target, vocab_size)``
+    (imported at ``lab/s01_b1_microbatches.py:8``), which shifts internally —
+    callers pass the *input* token batch as the target
+    (``lab/s01_b2_dp_pp.py`` last-stage loss).
+
+    Args:
+      logits: ``[B, L, V]``.
+      tokens: ``[B, L]`` input token ids (targets derived by shifting).
+      pad_id: optional id masked out of the loss.
+    """
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    per_tok = logz - picked
+    if pad_id is not None:
+        mask = (targets != pad_id).astype(jnp.float32)
+        return (per_tok * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return per_tok.mean()
+
+
+def accuracy(outputs: jax.Array, labels: jax.Array) -> jax.Array:
+    """Top-1 accuracy from logits or log-probs."""
+    return (outputs.argmax(axis=-1) == labels).mean()
+
+
+def vae_loss(
+    recon: jax.Array, x: jax.Array, mu: jax.Array, logvar: jax.Array
+) -> jax.Array:
+    """Summed reconstruction MSE + KL divergence, parity with ``customLoss``
+    (``lab/tutorial_2a/generative-modeling.py:118-127``)."""
+    recon = recon.astype(jnp.float32)
+    x = x.astype(jnp.float32)
+    mse = jnp.sum((recon - x) ** 2)
+    kld = -0.5 * jnp.sum(1.0 + logvar - mu**2 - jnp.exp(logvar))
+    return mse + kld

@@ -1,0 +1,11 @@
+from ddl25spring_tpu.parallel.dp import (
+    make_dp_train_step,
+    make_dp_weight_avg_step,
+    make_train_step,
+)
+
+__all__ = [
+    "make_dp_train_step",
+    "make_dp_weight_avg_step",
+    "make_train_step",
+]

@@ -1,0 +1,154 @@
+"""Data parallelism.
+
+The reference implements DP as per-rank processes that, after ``backward()``,
+flatten every gradient into one vector, ``all_reduce(SUM)`` it over gloo,
+unflatten, divide by world size, and step
+(``lab/tutorial_1b/DP/gradient_aggr/intro_DP_GA.py:53-66``;
+the same flatten/all_reduce/unflatten appears per stage-group in
+``lab/s01_b2_dp_pp.py:205-224``).
+
+TPU-native design: ONE jitted SPMD program over a mesh ``data`` axis.  The
+global batch is sharded over the axis; ``jax.lax.pmean`` of the gradient
+pytree *is* the all_reduce+divide (no flattening — XLA fuses the collective
+over the tree).  The optimizer update runs on replicated params outside the
+``shard_map`` so any optax transform works unchanged.
+
+Two aggregation flavors, matching the reference's two scripts:
+
+- gradient aggregation (``make_dp_train_step``): pmean grads, then step —
+  mathematically identical to large-batch serial SGD;
+- weight aggregation (``make_dp_weight_avg_step``): step locally on local
+  grads, then pmean the *weights*.  The reference's version is a silent no-op
+  (``intro_DP_WA.py:57`` compares a tensor to None; ``:67`` rebinds the loop
+  variable) — this implements the *intent*, i.e. real periodic weight
+  averaging with per-replica optimizer state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+# loss_fn(params, batch, key) -> scalar
+LossFn = Callable[[Any, Any, jax.Array], jax.Array]
+
+
+def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation):
+    """Single-device jitted trainstep (parity: the centralized loop of
+    ``lab/tutorial_1b/primer/intro.py:23-33``).  Serves as the serial side of
+    the DP-equivalence oracle (SURVEY §4)."""
+
+    @jax.jit
+    def step(params, opt_state, batch, key):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_dp_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "data",
+    per_shard_rng: bool = True,
+):
+    """Gradient-aggregation DP trainstep over ``mesh[axis]``.
+
+    The batch pytree is sharded on its leading dim; params/opt_state are
+    replicated.  ``per_shard_rng`` folds the shard index into the dropout key
+    so different shards don't reuse dropout masks (set False for bitwise
+    serial-equivalence tests with deterministic losses).
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    def loss_and_pmean_grad(params, batch, key):
+        if per_shard_rng:
+            key = jax.random.fold_in(key, lax.axis_index(axis))
+
+        # The pmean sits INSIDE the differentiated function: its transpose
+        # scales each shard's cotangent by 1/n, and shard_map's autodiff
+        # psums the cotangent of the axis-invariant ``params`` — together
+        # exactly the all_reduce(SUM)+divide of intro_DP_GA.py:63-66, over
+        # ICI instead of gloo.
+        def global_loss(params):
+            return lax.pmean(loss_fn(params, batch, key), axis)
+
+        return jax.value_and_grad(global_loss)(params)
+
+    @jax.jit
+    def step(params, opt_state, batch, key):
+        loss, grads = loss_and_pmean_grad(params, batch, key)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def make_dp_weight_avg_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "data",
+    per_shard_rng: bool = True,
+):
+    """Weight-aggregation DP: local step, then average weights over ``axis``.
+
+    Per-replica optimizer state is represented as a stacked pytree with a
+    leading ``[n_replicas, ...]`` dim sharded over ``axis`` (build it with
+    :func:`stack_opt_state`).  Params enter and leave replicated (averaged
+    every step, i.e. sync_every=1, the reference scripts' cadence).
+    """
+    n = mesh.shape[axis]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P(axis), P()),
+    )
+    def local_step_then_avg(params, opt_state_stacked, batch, key):
+        if per_shard_rng:
+            key = jax.random.fold_in(key, lax.axis_index(axis))
+        opt_state = jax.tree.map(lambda x: x[0], opt_state_stacked)
+        # Mark params as axis-varying so autodiff yields LOCAL grads (no
+        # implicit cross-shard psum) — each replica steps on its own data,
+        # as each reference rank does before the weight sync.
+        local_params = lax.pcast(params, axis, to="varying")
+        loss, grads = jax.value_and_grad(loss_fn)(local_params, batch, key)
+        updates, opt_state = tx.update(grads, opt_state, local_params)
+        stepped = optax.apply_updates(local_params, updates)
+        # the *intended* all_reduce-of-weights of intro_DP_WA.py:54-67
+        avg_params = lax.pmean(stepped, axis)
+        return (
+            avg_params,
+            jax.tree.map(lambda x: x[None], opt_state),
+            lax.pmean(loss, axis),
+        )
+
+    @jax.jit
+    def step(params, opt_state_stacked, batch, key):
+        return local_step_then_avg(params, opt_state_stacked, batch, key)
+
+    return step
+
+
+def stack_opt_state(opt_state, n: int):
+    """Replicate an optax state into the stacked ``[n, ...]`` layout used by
+    :func:`make_dp_weight_avg_step`."""
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + jnp.shape(x)), opt_state)

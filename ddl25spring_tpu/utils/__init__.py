@@ -1,0 +1,12 @@
+from ddl25spring_tpu.utils.mesh import make_mesh, mesh_axis_sizes
+from ddl25spring_tpu.utils.prng import client_round_key, data_key
+from ddl25spring_tpu.utils.metrics import RunResult, Timer
+
+__all__ = [
+    "make_mesh",
+    "mesh_axis_sizes",
+    "client_round_key",
+    "data_key",
+    "RunResult",
+    "Timer",
+]

@@ -1,0 +1,71 @@
+"""Config dataclasses.
+
+The reference keeps hyperparameters as module-level constants
+(``dmodel=288 ... batch_size=3`` at ``lab/s01_b1_microbatches.py:21-26``) and
+the rank as the only CLI arg.  Here each workload gets a small frozen
+dataclass; mesh topology replaces ranks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    """Reference workload constants: ``lab/s01_b1_microbatches.py:21-26``."""
+
+    vocab_size: int = 4096
+    dmodel: int = 288
+    num_heads: int = 6
+    n_layers: int = 6
+    ctx_size: int = 256
+    pad_id: int = 0
+    dtype: str = "bfloat16"     # MXU-friendly compute dtype; params stay fp32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dmodel // self.num_heads
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    """Reference: 3 stages x 3 microbatches, batch 3, Adam lr=8e-4
+    (``lab/s01_b1_microbatches.py:24-26,64,66``; ``lab/run-b1.sh``)."""
+
+    num_stages: int = 3
+    num_microbatches: int = 3
+    batch_size: int = 3
+    learning_rate: float = 8e-4
+
+
+@dataclass(frozen=True)
+class DpPpConfig:
+    """Reference: 2 pipelines x 3 stages, world 6
+    (``lab/s01_b2_dp_pp.py:22-34``)."""
+
+    data: int = 2
+    num_stages: int = 3
+    num_microbatches: int = 3
+    per_replica_batch: int = 3
+    learning_rate: float = 8e-4
+
+
+@dataclass(frozen=True)
+class FlConfig:
+    """Tutorial defaults: lr=0.01, E=1, B=100, 10 rounds, seed=10
+    (``lab/homework-1.ipynb`` cell 5; BASELINE.md)."""
+
+    nr_clients: int = 10
+    client_fraction: float = 0.1
+    batch_size: int = 100      # -1 = full batch (FedSGD)
+    nr_local_epochs: int = 1
+    learning_rate: float = 0.01
+    nr_rounds: int = 10
+    iid: bool = True
+    seed: int = 10
+
+
+def replace(cfg, **kw):
+    return dataclasses.replace(cfg, **kw)

@@ -1,0 +1,82 @@
+"""Device-mesh construction.
+
+The reference builds its "cluster" out of N OS processes rendezvousing over
+gloo TCP (``lab/s01_b1_microbatches.py:16-19``) and carves communicators out
+of it with ``dist.new_group`` (``lab/s01_b2_dp_pp.py:32-34``).  On TPU the
+equivalent object is a single ``jax.sharding.Mesh`` over the chips of a pod
+slice: named axes replace process groups, and collectives ride ICI.
+
+The reference's 6-process DP x PP topology (pipelines {0,1,2} / {3,4,5} with
+per-stage DP groups {0,3},{1,4},{2,5} — ``lab/s01_b2_dp_pp.py:22-34``) is the
+2-D mesh ``make_mesh(data=2, stage=3)``: the ``data`` axis is the per-stage
+DP group, the ``stage`` axis is the pipeline.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None, **axes: int) -> Mesh:
+    """Build a named mesh, e.g. ``make_mesh(data=2, stage=3)``.
+
+    Axis sizes of ``-1`` are inferred from the device count (at most one).
+    With no axes given, returns a 1-D ``data`` mesh over all devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if not axes:
+        axes = {"data": len(devices)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if len(devices) % known != 0:
+            raise ValueError(
+                f"cannot infer -1 axis: {len(devices)} devices not divisible by {known}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = math.prod(sizes)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (the mesh analogue of every rank holding a
+    full copy of the model, as every reference rank does)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis`` — the mesh analogue of the
+    reference's disjoint per-rank data streams (``skip=rank*N`` at
+    ``lab/tutorial_1b/DP/gradient_aggr/intro_DP_GA.py:29``)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def host_cpu_devices(n: int) -> list[jax.Device]:
+    """CPU devices for mesh simulation in tests (the TPU-world analogue of the
+    reference's gloo-on-localhost fake cluster, SURVEY §4). Requires
+    ``--xla_force_host_platform_device_count=n`` in ``XLA_FLAGS``."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} CPU devices, have {len(cpus)}; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before importing jax"
+        )
+    return cpus[:n]

@@ -1,0 +1,29 @@
+"""PRNG discipline.
+
+The reference derives determinism from global seeds (``torch.manual_seed(0)``
+at ``lab/s01_b1_microbatches.py:20``) and a per-client-per-round arithmetic
+seed ``client_round_seed = seed + ind + 1 + round * clients_per_round``
+(``lab/tutorial_1a/hfl_complete.py:289``).  The JAX-native equivalent is
+splitting/folding typed keys — collision-free by construction and vmappable.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def client_round_key(base: jax.Array, round_idx, client_idx) -> jax.Array:
+    """Key for one client's local update in one round.
+
+    Mirrors the *intent* of ``hfl_complete.py:289`` (distinct randomness per
+    (round, client) pair) without its arithmetic collisions.  Traceable:
+    ``round_idx`` / ``client_idx`` may be tracers, so this folds cleanly under
+    ``vmap`` over clients.
+    """
+    return jax.random.fold_in(jax.random.fold_in(base, round_idx), client_idx)
+
+
+def data_key(base: jax.Array, epoch) -> jax.Array:
+    """Key for epoch-level data shuffling (reference: generator-seeded
+    DataLoaders, ``hfl_complete.py:149-151``)."""
+    return jax.random.fold_in(base, epoch)

@@ -1,0 +1,33 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The TPU-world analogue of the reference's gloo-on-localhost fake cluster
+(SURVEY §4): ``--xla_force_host_platform_device_count=8`` gives every test a
+multi-device mesh without hardware.
+
+XLA_FLAGS must be set before the CPU backend initializes; the platform
+selection must be forced through ``jax.config`` because this image's
+sitecustomize registers a TPU plugin at interpreter start (before conftest),
+so the ``JAX_PLATFORMS`` env var alone is too late.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest failed to fake 8 CPU devices"
+    return devs[:8]

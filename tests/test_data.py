@@ -1,0 +1,59 @@
+"""Data pipeline tests: determinism and the non-IID splitter invariants
+(reference splitter semantics at ``lab/tutorial_1a/hfl_complete.py:91-104``)."""
+
+import numpy as np
+
+from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.data.splitter import split_indices, stack_client_data
+
+
+def test_mnist_deterministic_and_normalized():
+    load_mnist.cache_clear()
+    a = load_mnist(n_train=256, n_test=64)
+    load_mnist.cache_clear()
+    b = load_mnist(n_train=256, n_test=64)
+    np.testing.assert_array_equal(a["x_train"], b["x_train"])
+    assert a["x_train"].shape == (256, 28, 28, 1)
+    assert a["y_train"].dtype == np.int32
+    assert set(np.unique(a["y_train"])) <= set(range(10))
+
+
+def test_split_iid_partitions_everything():
+    labels = np.repeat(np.arange(10), 100)
+    splits = split_indices(labels, nr_clients=7, iid=True, seed=10)
+    allidx = np.concatenate(splits)
+    assert len(allidx) == 1000
+    assert len(np.unique(allidx)) == 1000
+
+
+def test_split_noniid_limits_labels_per_client():
+    labels = np.repeat(np.arange(10), 100)
+    splits = split_indices(labels, nr_clients=10, iid=False, seed=10)
+    allidx = np.concatenate(splits)
+    assert len(np.unique(allidx)) == 1000
+    for s in splits:
+        # each client gets 2 shards of a label-sorted array => <= ~3 labels
+        assert len(np.unique(labels[s])) <= 4
+    # non-IID must be skewed: some client sees fewer labels than the full set
+    assert min(len(np.unique(labels[s])) for s in splits) <= 2
+
+
+def test_split_seed_determinism():
+    labels = np.repeat(np.arange(10), 50)
+    a = split_indices(labels, 5, False, seed=10)
+    b = split_indices(labels, 5, False, seed=10)
+    c = split_indices(labels, 5, False, seed=11)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_stack_client_data_pads_and_counts():
+    x = np.arange(10, dtype=np.float32)[:, None]
+    y = np.arange(10, dtype=np.int32)
+    splits = [np.array([0, 1, 2]), np.array([3, 4, 5, 6, 7, 8, 9])]
+    xs, ys, counts = stack_client_data(x, y, splits)
+    assert xs.shape == (2, 7, 1)
+    np.testing.assert_array_equal(counts, [3, 7])
+    # padding repeats the client's own data
+    assert set(ys[0].tolist()) == {0, 1, 2}

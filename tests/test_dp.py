@@ -1,0 +1,105 @@
+"""DP correctness: the reference's strongest testing idea is equivalence as a
+correctness oracle (homework A1, ``lab/series01.ipynb`` cell 9; SURVEY §4).
+Here: DP-sharded trainstep == single-device trainstep on the same global
+batch, to fp32 tolerance."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.models.mnist_cnn import MnistCnn
+from ddl25spring_tpu.ops.losses import nll_loss
+from ddl25spring_tpu.parallel.dp import (
+    make_dp_train_step,
+    make_dp_weight_avg_step,
+    make_train_step,
+    stack_opt_state,
+)
+from ddl25spring_tpu.utils.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = MnistCnn()
+    data = load_mnist(n_train=512, n_test=256)
+    key = jax.random.PRNGKey(0)
+    params = model.init(key, data["x_train"][:1])["params"]
+
+    def loss_fn(params, batch, key):
+        x, y = batch
+        out = model.apply({"params": params}, x, train=False)
+        return nll_loss(out, y)
+
+    return model, data, params, loss_fn
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_dp_equals_serial(setup, n_dev, devices8):
+    _, data, params, loss_fn = setup
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    mesh = make_mesh(devices8[:n_dev], data=n_dev)
+
+    serial = make_train_step(loss_fn, tx)
+    dp = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+
+    batch = (jnp.asarray(data["x_train"][:64]), jnp.asarray(data["y_train"][:64]))
+    key = jax.random.PRNGKey(1)
+
+    p_s, o_s, loss_s = serial(params, opt_state, batch, key)
+    p_d, o_d, loss_d = dp(params, opt_state, batch, key)
+
+    np.testing.assert_allclose(float(loss_s), float(loss_d), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        p_s,
+        jax.device_get(p_d),
+    )
+
+
+def test_dp_loss_decreases(setup, devices8):
+    _, data, params, loss_fn = setup
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    mesh = make_mesh(devices8[:4], data=4)
+    dp = make_dp_train_step(loss_fn, tx, mesh)
+
+    key = jax.random.PRNGKey(2)
+    batch = (
+        jnp.asarray(data["x_train"][:64]),
+        jnp.asarray(data["y_train"][:64]),
+    )
+    losses = []
+    for i in range(20):
+        params, opt_state, loss = dp(params, opt_state, batch, jax.random.fold_in(key, i))
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_weight_avg_equals_grad_avg_for_sgd(setup, devices8):
+    """With plain SGD and sync-every-step, averaging weights after local
+    steps == averaging gradients (linearity) — the equivalence the homework
+    A1 oracle is built on, transplanted to DP."""
+    _, data, params, loss_fn = setup
+    tx = optax.sgd(0.05)
+    mesh = make_mesh(devices8[:4], data=4)
+
+    ga = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+    wa = make_dp_weight_avg_step(loss_fn, tx, mesh, per_shard_rng=False)
+
+    batch = (jnp.asarray(data["x_train"][:64]), jnp.asarray(data["y_train"][:64]))
+    key = jax.random.PRNGKey(3)
+
+    p_g, _, _ = ga(params, tx.init(params), batch, key)
+    p_w, _, _ = wa(params, stack_opt_state(tx.init(params), 4), batch, key)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-5, rtol=1e-5
+        ),
+        p_g,
+        p_w,
+    )

@@ -1,0 +1,42 @@
+"""Model shape/behavior checks (the reference ships no tests — SURVEY §4 —
+so shapes are pinned here against the reference architectures)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl25spring_tpu.models.heart_mlp import HeartDiseaseNN
+from ddl25spring_tpu.models.mnist_cnn import MnistCnn
+
+
+def test_mnist_cnn_shapes_and_logprobs():
+    model = MnistCnn()
+    x = jnp.zeros((4, 28, 28, 1))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (4, 10)
+    # log_softmax rows sum to 1 in prob space
+    np.testing.assert_allclose(np.exp(out).sum(-1), np.ones(4), rtol=1e-5)
+    # flatten feeds 9216 features into fc1, per hfl_complete.py:47
+    assert variables["params"]["Dense_0"]["kernel"].shape == (9216, 128)
+
+
+def test_mnist_cnn_dropout_needs_rng_and_differs():
+    model = MnistCnn()
+    x = jnp.ones((2, 28, 28, 1))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    a = model.apply(variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    b = model.apply(variables, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(a, b)
+
+
+def test_heart_mlp_shapes():
+    model = HeartDiseaseNN()
+    x = jnp.zeros((8, 30))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (8, 2)
+    shapes = [
+        variables["params"][f"Dense_{i}"]["kernel"].shape for i in range(4)
+    ]
+    assert shapes == [(30, 64), (64, 128), (128, 256), (256, 2)]
