@@ -1,0 +1,62 @@
+"""Tokenizers.
+
+The reference tokenizes through simplellm's ``SPTokenizer`` (SentencePiece,
+C++ — ``lab/s01_b1_microbatches.py:6,31``), whose artifacts are gitignored
+(``lab/tutorial_1b/.gitignore:8,28``) and fetched at first run.  Tokenization
+never runs on TPU (SURVEY §2), so the in-tree default is a dependency-free
+byte-level tokenizer with the same API surface (``vocab_size``, ``pad_id``,
+``encode``/``decode``); a SentencePiece wrapper is provided when the package
+is importable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: ids = byte value + 3; 0/1/2 = pad/bos/eos."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    vocab_size = 256 + 3
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        return ([self.bos_id] if add_bos else []) + ids
+
+    def decode(self, ids) -> str:
+        return bytes(i - 3 for i in np.asarray(ids).tolist() if i >= 3).decode(
+            "utf-8", errors="replace"
+        )
+
+
+class SentencePieceTokenizer:
+    """Wrapper matching simplellm's ``SPTokenizer`` surface, gated on the
+    sentencepiece package being available (it is host-side C++, off the TPU
+    hot path)."""
+
+    def __init__(self, model_path: str):
+        import sentencepiece as spm  # gated import
+
+        self._sp = spm.SentencePieceProcessor(model_file=model_path)
+        self.vocab_size = self._sp.vocab_size()
+        # keep SentencePiece's -1 sentinel when the model has no pad piece:
+        # coercing to 0 would alias <unk> and silently mask it out of losses
+        self.pad_id = self._sp.pad_id()
+        self.bos_id = self._sp.bos_id()
+        self.eos_id = self._sp.eos_id()
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._sp.encode(text)
+        return ([self.bos_id] if add_bos and self.bos_id >= 0 else []) + ids
+
+    def decode(self, ids) -> str:
+        return self._sp.decode(np.asarray(ids).tolist())
+
+
+def get_tokenizer(model_path: str | None = None):
+    if model_path is not None:
+        return SentencePieceTokenizer(model_path)
+    return ByteTokenizer()

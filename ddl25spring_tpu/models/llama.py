@@ -1,0 +1,184 @@
+"""LLaMA-style decoder, stage-splittable for pipeline parallelism.
+
+The reference trains a LLaMA from the external ``simplellm`` package, split
+into ``LLamaFirstStage`` (``.embed``), ``LLamaStage``, ``LLamaLastStage``
+(logits) — one torch module per pipeline rank
+(``lab/s01_b1_microbatches.py:30-61``) with workload constants dmodel=288,
+6 heads, 6 layers, ctx 256 (``:21-24``).  This build keeps the whole model in
+ONE parameter pytree with the transformer blocks *stacked* on a leading layer
+axis, so pipeline partitioning is a reshape ``[L, ...] -> [S, L/S, ...]`` and
+a ``PartitionSpec('stage', ...)`` — no per-stage module classes.
+
+TPU-first choices:
+- functional core (pure functions over explicit pytrees): composes freely
+  with ``shard_map`` / ``scan`` / ``grad`` for the pipeline schedule;
+- blocks applied via ``lax.scan`` over the stacked layer axis (one compiled
+  block body regardless of depth);
+- RMSNorm / RoPE / SwiGLU per LLaMA convention; attention einsums run in
+  ``cfg.dtype`` (bfloat16 on TPU: MXU-native) with fp32 softmax and fp32
+  master params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------- init
+
+
+def _dense(key, shape, scale=0.02):
+    return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+
+def init_block_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    d, f = cfg.dmodel, cfg.ffn_dim
+    ks = jax.random.split(key, 7)
+    return {
+        "ln1": jnp.ones((d,), jnp.float32),
+        "wq": _dense(ks[0], (d, d)),
+        "wk": _dense(ks[1], (d, d)),
+        "wv": _dense(ks[2], (d, d)),
+        "wo": _dense(ks[3], (d, d)),
+        "ln2": jnp.ones((d,), jnp.float32),
+        "w_gate": _dense(ks[4], (d, f)),
+        "w_up": _dense(ks[5], (d, f)),
+        "w_down": _dense(ks[6], (f, d)),
+    }
+
+
+def init_llama_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Full model: ``embed [V,D]``, stacked ``blocks [L,...]``, final-norm
+    scale, ``unembed [D,V]``."""
+    k_embed, k_blocks, k_out = jax.random.split(key, 3)
+    block_keys = jax.random.split(k_blocks, cfg.n_layers)
+    blocks = jax.vmap(lambda k: init_block_params(k, cfg))(block_keys)
+    return {
+        "embed": _dense(k_embed, (cfg.vocab_size, cfg.dmodel)),
+        "blocks": blocks,
+        "ln_f": jnp.ones((cfg.dmodel,), jnp.float32),
+        "unembed": _dense(k_out, (cfg.dmodel, cfg.vocab_size)),
+    }
+
+
+# ---------------------------------------------------------------- forward
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 / rms) * scale).astype(x.dtype)
+
+
+def rope_angles(seq_len: int, head_dim: int, base: float = 10_000.0):
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    inv = base ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    ang = pos[:, None] * inv[None, :]  # [L, hd/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    # x: [B, L, H, hd]; rotate pairs (even, odd)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def block_forward(p: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """One pre-norm transformer block: RMSNorm -> causal RoPE attention ->
+    residual -> RMSNorm -> SwiGLU -> residual."""
+    dtype = jnp.dtype(cfg.dtype)
+    B, L, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+
+    h = rms_norm(x, p["ln1"])
+    q = (h @ p["wq"].astype(dtype)).reshape(B, L, H, hd)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, L, H, hd)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, L, H, hd)
+    cos, sin = rope_angles(L, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    mask = jnp.tril(jnp.ones((L, L), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhlm,bmhd->blhd", probs, v).reshape(B, L, D)
+    x = x + attn @ p["wo"].astype(dtype)
+
+    h = rms_norm(x, p["ln2"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+    up = h @ p["w_up"].astype(dtype)
+    x = x + (gate * up) @ p["w_down"].astype(dtype)
+    return x
+
+
+def apply_blocks(stacked: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Apply a stack of blocks (leading layer axis) via ``lax.scan`` — the
+    compiler-friendly loop (one block body compiled once)."""
+
+    def body(h, block_p):
+        return block_forward(block_p, h, cfg), None
+
+    out, _ = lax.scan(body, x, stacked)
+    return out
+
+
+def embed(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Token embedding (parity: ``LLamaFirstStage.embed``,
+    ``lab/s01_b1_microbatches.py:84``)."""
+    return params["embed"].astype(jnp.dtype(cfg.dtype))[tokens]
+
+
+def unembed(params: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Final norm + output projection to logits (parity: ``LLamaLastStage``
+    producing logits, ``lab/s01_b1_microbatches.py:52-59``)."""
+    h = rms_norm(x, params["ln_f"])
+    return (h @ params["unembed"].astype(h.dtype)).astype(jnp.float32)
+
+
+def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Full unpartitioned forward: the serial side of the pipeline
+    equivalence oracle (SURVEY §4)."""
+    x = embed(params, tokens, cfg)
+    x = apply_blocks(params["blocks"], x, cfg)
+    return unembed(params, x, cfg)
+
+
+# ---------------------------------------------------------------- stage split
+
+
+def split_blocks_for_stages(params: Params, num_stages: int) -> Params:
+    """Reshape stacked blocks ``[L, ...] -> [S, L/S, ...]``.  Sharding dim 0
+    over the mesh ``stage`` axis gives each stage its contiguous layer slice —
+    the mesh analogue of ``n_layers = 6 // world_size`` per rank
+    (``lab/s01_b1_microbatches.py:23``)."""
+    L = jax.tree.leaves(params["blocks"])[0].shape[0]
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+    per = L // num_stages
+    out = dict(params)
+    out["blocks"] = jax.tree.map(
+        lambda x: x.reshape((num_stages, per) + x.shape[1:]), params["blocks"]
+    )
+    return out
+
+
+def merge_blocks_from_stages(params: Params) -> Params:
+    """Inverse of :func:`split_blocks_for_stages`."""
+    out = dict(params)
+    out["blocks"] = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), params["blocks"]
+    )
+    return out

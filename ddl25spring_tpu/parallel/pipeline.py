@@ -1,0 +1,226 @@
+"""Pipeline parallelism (GPipe-style microbatching) and DPxPP hybrids.
+
+What the reference does with 3 (or 6) OS processes — ``isend/irecv`` chains
+with per-microbatch tags, activation stacks drained LIFO for backward, and
+per-stage-group ``all_reduce`` (``lab/s01_b1_microbatches.py:66-178``,
+``lab/s01_b2_dp_pp.py:93-227``) — is here ONE jitted SPMD program:
+
+- the pipeline is a ``lax.scan`` over ``T = M + S - 1`` ticks inside a
+  ``shard_map`` over the mesh ``stage`` axis; each tick every stage applies
+  its layer slice and hands its activation to the next stage via
+  ``lax.ppermute`` (an XLA collective-permute riding ICI — the tag/FIFO
+  machinery of gloo send/recv is replaced by program order, SURVEY §5);
+- backward is NOT hand-written: ``jax.grad`` differentiates through the
+  scanned ppermute schedule, which *is* the reverse pipeline with LIFO
+  activation consumption (XLA rematerializes/buffers activations; the
+  reference's ``acc_outs.pop().backward(g)`` drain falls out of the scan
+  transpose);
+- microbatch gradient accumulation (the ``.grad`` accumulation across
+  microbatches, ``s01_b1_microbatches.py:148-177``) falls out of summing the
+  per-microbatch losses in the scan carry;
+- the DP dimension of the hybrid (per-stage-group all_reduce, flatten/
+  unflatten at ``s01_b2_dp_pp.py:205-224``) is the automatic psum of
+  cotangents over the ``data`` axis for data-invariant params, scaled by the
+  ``pmean`` in the loss.
+
+The schedule computed is exactly GPipe: all forwards stream through, then
+all backwards (the transpose drains in reverse) — matching the homework B1
+solution's schedule, with the bubble fraction (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.ops.losses import causal_lm_loss
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Params = dict[str, Any]
+
+# PartitionSpec prefix for staged llama params: blocks carry a leading
+# [num_stages] dim sharded over the stage axis; embed/unembed replicated
+# (cheap relative to blocks; the FLOPs live in the MXU matmuls).
+def staged_param_specs(stage_axis: str = "stage") -> Params:
+    return {
+        "embed": P(),
+        "blocks": P(stage_axis),
+        "ln_f": P(),
+        "unembed": P(),
+    }
+
+
+def make_pipeline_loss(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
+
+    ``params`` is a llama pytree with blocks pre-split by
+    :func:`~ddl25spring_tpu.models.llama.split_blocks_for_stages` into
+    ``[S, L/S, ...]``.  ``tokens`` is ``[B, L]`` with
+    ``B = num_microbatches * microbatch_size`` (times the data-axis size
+    when ``data_axis`` is given — the global batch, like the reference's
+    disjoint per-pipeline streams at ``s01_b2_dp_pp.py:60,78``).
+    """
+    S = mesh.shape[stage_axis]
+    M = num_microbatches
+    dtype = jnp.dtype(cfg.dtype)
+
+    tok_spec = P(None, data_axis)  # [M, mb, L]: shard microbatch dim over data
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(staged_param_specs(stage_axis), tok_spec),
+        out_specs=P(),
+    )
+    def pipelined(params: Params, tokens_mb: jax.Array) -> jax.Array:
+        local_blocks = jax.tree.map(lambda x: x[0], params["blocks"])
+        s = lax.axis_index(stage_axis)
+        mb, L = tokens_mb.shape[1], tokens_mb.shape[2]
+        axes = (stage_axis,) + ((data_axis,) if data_axis else ())
+
+        # Varying copies of the embed/unembed params, cast OUTSIDE the scan:
+        # their cotangent psum (the transpose of this pcast) then executes
+        # uniformly on every device.  Using the invariant originals inside
+        # ``lax.cond`` would put that psum inside a branch only the last
+        # stage takes — a collective in non-uniform control flow.
+        head = lax.pcast(
+            {k: params[k] for k in ("embed", "ln_f", "unembed")},
+            axes,
+            to="varying",
+        )
+
+        def tick(carry, t):
+            incoming, loss_sum = carry
+            # stage 0 injects microbatch t (embed is a cheap gather; the
+            # clamp keeps the index static-shaped during drain ticks)
+            x_first = llama.embed(head, tokens_mb[jnp.minimum(t, M - 1)], cfg)
+            x_in = jnp.where(s == 0, x_first, incoming)
+            x_out = llama.apply_blocks(local_blocks, x_in, cfg)
+
+            # last stage finishes microbatch t-(S-1) on this tick
+            done = t - (S - 1)
+            tgt = tokens_mb[jnp.clip(done, 0, M - 1)]
+            # lax.cond so non-last stages skip the unembed matmul entirely;
+            # the zero branch must carry the same varying-axis type as the
+            # loss branch (JAX 0.9 shard_map VMA typing)
+            loss_mb = lax.cond(
+                jnp.logical_and(s == S - 1, done >= 0),
+                lambda x, y: causal_lm_loss(llama.unembed(head, x, cfg), y),
+                lambda x, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                x_out,
+                tgt,
+            )
+
+            # hand activation to the next stage: the isend/irecv chain of
+            # s01_b1_microbatches.py:87-140 as one collective-permute
+            outgoing = lax.ppermute(
+                x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (outgoing, loss_sum + loss_mb), None
+
+        carry0 = (
+            lax.pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
+            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+        )
+        (_, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
+
+        total = lax.psum(loss_sum, stage_axis) / M
+        if data_axis is not None:
+            total = lax.pmean(total, data_axis)
+        return total
+
+    def loss(params: Params, tokens: jax.Array) -> jax.Array:
+        B, L = tokens.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        tokens_mb = tokens.reshape(M, B // M, L)
+        return pipelined(params, tokens_mb)
+
+    return loss
+
+
+def make_pipeline_train_step(
+    cfg: LlamaConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    num_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Jitted train step for the (DPx)PP llama workload: the one-program
+    replacement for the reference's 3- or 6-process schedule + per-group
+    all_reduce + Adam step (``s01_b2_dp_pp.py:93-227``)."""
+    loss_fn = make_pipeline_loss(cfg, mesh, num_microbatches, stage_axis, data_axis)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def shard_staged_params(params: Params, mesh: Mesh, stage_axis: str = "stage"):
+    """Place staged params on the mesh: blocks sharded over the stage axis,
+    the rest replicated — each device holds only its stages' layers, like
+    each reference rank building only its own ``LLamaStage``."""
+    specs = staged_param_specs(stage_axis)
+    shardings = {
+        "embed": NamedSharding(mesh, specs["embed"]),
+        "blocks": jax.tree.map(
+            lambda _: NamedSharding(mesh, specs["blocks"]), params["blocks"]
+        ),
+        "ln_f": NamedSharding(mesh, specs["ln_f"]),
+        "unembed": NamedSharding(mesh, specs["unembed"]),
+    }
+    return jax.device_put(params, shardings)
+
+
+def make_grad_accum_step(
+    loss_fn: Callable, tx: optax.GradientTransformation, num_microbatches: int
+):
+    """Single-device microbatch gradient accumulation: chunk the batch, scan
+    per-microbatch grads into a summed carry, one optimizer step — the
+    capability of ``s01_b1_microbatches.py``'s grad accumulation (homework
+    note on unzeroed ``.grad``, ``homework-1.ipynb`` cell 33) as a scan carry.
+
+    ``loss_fn(params, batch, key) -> scalar``; batch leaves are chunked on
+    their leading dim.
+    """
+    M = num_microbatches
+
+    @jax.jit
+    def step(params, opt_state, batch, key):
+        chunked = jax.tree.map(
+            lambda x: x.reshape((M, x.shape[0] // M) + x.shape[1:]), batch
+        )
+
+        def micro(acc, mb):
+            mb_batch, k = mb
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb_batch, k)
+            return jax.tree.map(jnp.add, acc, (grads, loss)), None
+
+        zero = (jax.tree.map(jnp.zeros_like, params), jnp.float32(0.0))
+        keys = jax.random.split(key, M)
+        (gsum, lsum), _ = lax.scan(micro, zero, (chunked, keys))
+        grads = jax.tree.map(lambda g: g / M, gsum)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, lsum / M
+
+    return step

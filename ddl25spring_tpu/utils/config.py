@@ -28,6 +28,10 @@ class LlamaConfig:
     def head_dim(self) -> int:
         return self.dmodel // self.num_heads
 
+    @property
+    def ffn_dim(self) -> int:
+        return 4 * self.dmodel
+
 
 @dataclass(frozen=True)
 class PipelineConfig:

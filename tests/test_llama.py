@@ -1,0 +1,65 @@
+"""LLaMA model unit tests: shapes, causality, determinism, stage splitting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+CFG = LlamaConfig(
+    vocab_size=64, dmodel=32, num_heads=2, n_layers=4, ctx_size=16, dtype="float32"
+)
+
+
+def test_forward_shapes():
+    params = llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 16), 0, 64)
+    logits = llama.llama_forward(params, tokens, CFG)
+    assert logits.shape == (3, 16, 64)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    """Changing a future token must not change past logits — the property
+    the reference's causal attention provides implicitly via simplellm."""
+    params = llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 64)
+    logits_a = llama.llama_forward(params, tokens, CFG)
+    tokens_b = tokens.at[0, 10].set((tokens[0, 10] + 1) % 64)
+    logits_b = llama.llama_forward(params, tokens_b, CFG)
+    np.testing.assert_allclose(
+        logits_a[0, :10], logits_b[0, :10], atol=1e-5, rtol=1e-5
+    )
+    assert not np.allclose(logits_a[0, 10:], logits_b[0, 10:])
+
+
+def test_stage_split_roundtrip_and_equivalence():
+    params = llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+    staged = llama.split_blocks_for_stages(params, 2)
+    assert jax.tree.leaves(staged["blocks"])[0].shape[:2] == (2, 2)
+    merged = llama.merge_blocks_from_stages(staged)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b),
+        params["blocks"],
+        merged["blocks"],
+    )
+    # applying [S, L/S] stages sequentially == applying [L] blocks
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    x = llama.embed(params, tokens, CFG)
+    full = llama.apply_blocks(params["blocks"], x, CFG)
+    y = x
+    for si in range(2):
+        y = llama.apply_blocks(
+            jax.tree.map(lambda p: p[si], staged["blocks"]), y, CFG
+        )
+    np.testing.assert_allclose(full, y, atol=1e-5, rtol=1e-5)
+
+
+def test_rope_rotation_preserves_norm():
+    cos, sin = llama.rope_angles(8, 4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 2, 4))
+    r = llama.apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(r, axis=-1), rtol=1e-5
+    )
