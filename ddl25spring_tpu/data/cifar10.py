@@ -1,0 +1,79 @@
+"""CIFAR-10 pipeline (benchmark dataset per BASELINE.json).
+
+Zero-egress build: loads the real binary batches when present
+(``DDL25_CIFAR10_DIR`` env var or ``data/cifar-10-batches-bin``), else a
+deterministic synthetic 32x32x3 class-prototype dataset with identical
+shapes/dtypes (throughput benchmarking is shape-bound, not content-bound).
+Arrays are NHWC float32, normalized per-channel with the canonical CIFAR-10
+train statistics.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from pathlib import Path
+
+import numpy as np
+
+MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def _find_dir() -> Path | None:
+    for cand in (
+        os.environ.get("DDL25_CIFAR10_DIR"),
+        "data/cifar-10-batches-bin",
+        "data/cifar10",
+    ):
+        if (
+            cand
+            and Path(cand).exists()
+            and (Path(cand) / "data_batch_1.bin").exists()
+            and (Path(cand) / "test_batch.bin").exists()
+        ):
+            return Path(cand)
+    return None
+
+
+def _read_bin(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    raw = np.frombuffer(path.read_bytes(), dtype=np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(np.int32)
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs.astype(np.float32) / 255.0, labels
+
+
+def _synthetic(n: int, seed: int, noise: float = 0.2):
+    proto_rng = np.random.default_rng(4242)
+    coarse = proto_rng.random((10, 8, 8, 3)).astype(np.float32)
+    protos = np.kron(coarse, np.ones((4, 4, 1), np.float32))  # [10, 32, 32, 3]
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    scale = rng.uniform(0.7, 1.0, size=(n, 1, 1, 1)).astype(np.float32)
+    imgs = protos[labels] * scale + rng.normal(0, noise, (n, 32, 32, 3)).astype(
+        np.float32
+    )
+    return np.clip(imgs, 0.0, 1.0), labels
+
+
+@lru_cache(maxsize=1)
+def load_cifar10(n_train: int = 50_000, n_test: int = 10_000, seed: int = 0):
+    d = _find_dir()
+    if d is not None:
+        train_parts = sorted(d.glob("data_batch_*.bin"))
+        xs, ys = zip(*(_read_bin(p) for p in train_parts))
+        x_tr, y_tr = np.concatenate(xs), np.concatenate(ys)
+        x_te, y_te = _read_bin(d / "test_batch.bin")
+    else:
+        x_tr, y_tr = _synthetic(n_train, seed)
+        x_te, y_te = _synthetic(n_test, seed + 1)
+
+    def norm(x):
+        return ((x - MEAN) / STD).astype(np.float32)
+
+    return {
+        "x_train": norm(x_tr[:n_train]),
+        "y_train": y_tr[:n_train],
+        "x_test": norm(x_te[:n_test]),
+        "y_test": y_te[:n_test],
+    }

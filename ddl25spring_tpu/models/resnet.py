@@ -1,0 +1,104 @@
+"""ResNet-18 (CIFAR variant) — the driver-set benchmark model.
+
+Not a reference component (the reference's ``run-b2.sh`` trains the simplellm
+LLaMA), but BASELINE.json's north star names DP+PP ResNet-18/CIFAR-10 at
+>= 5k samples/sec/chip, so it's first-class here.
+
+CIFAR-style ResNet-18: 3x3 stem (no maxpool), four groups of two residual
+blocks at 64/128/256/512 channels, stride-2 downsampling at group entry,
+global average pool, fc.  TPU-first: NHWC, bf16-friendly compute via the
+``dtype`` attr, and a ``norm`` switch —
+
+- ``"batch"``: flax BatchNorm (running stats in ``batch_stats``), the
+  conventional choice for the DP path (local per-shard statistics);
+- ``"group"``: GroupNorm, stateless — used in the pipeline path and in
+  vmapped federated clients, where mutable cross-step state is a liability.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: str = "batch"
+    dtype: Any = jnp.float32
+
+    def _norm(self):
+        if self.norm == "batch":
+            return partial(
+                nn.BatchNorm,
+                use_running_average=None,  # set via apply kwarg
+                momentum=0.9,
+                dtype=self.dtype,
+            )
+        return partial(
+            nn.GroupNorm, num_groups=min(32, self.filters // 4), dtype=self.dtype
+        )
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = self._norm()
+
+        def apply_norm(n, h):
+            if self.norm == "batch":
+                return n(use_running_average=not train)(h)
+            return n()(h)
+
+        residual = x
+        y = nn.Conv(
+            self.filters, (3, 3), (self.strides, self.strides),
+            padding="SAME", use_bias=False, dtype=self.dtype,
+        )(x)
+        y = apply_norm(norm, y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
+        )(y)
+        y = apply_norm(norm, y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), (self.strides, self.strides),
+                use_bias=False, dtype=self.dtype,
+            )(residual)
+            residual = apply_norm(norm, residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 10
+    norm: str = "batch"
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        w = self.width
+        y = nn.Conv(w, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        if self.norm == "batch":
+            y = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, dtype=self.dtype
+            )(y)
+        else:
+            y = nn.GroupNorm(num_groups=min(32, w // 4), dtype=self.dtype)(y)
+        y = nn.relu(y)
+        for gi, (filters, stride) in enumerate(
+            [(w, 1), (2 * w, 2), (4 * w, 2), (8 * w, 2)]
+        ):
+            for bi in range(2):
+                y = ResNetBlock(
+                    filters,
+                    strides=stride if bi == 0 else 1,
+                    norm=self.norm,
+                    dtype=self.dtype,
+                )(y, train=train)
+        y = jnp.mean(y, axis=(1, 2))
+        y = nn.Dense(self.num_classes, dtype=jnp.float32)(y)
+        return y

@@ -1,0 +1,15 @@
+"""Driver-contract tests for __graft_entry__.py."""
+
+import jax
+
+import __graft_entry__
+
+
+def test_entry_compiles_and_runs():
+    fn, args = __graft_entry__.entry()
+    loss = float(jax.jit(fn)(*args))
+    assert loss == loss and loss > 0  # finite, positive
+
+
+def test_dryrun_multichip_8():
+    __graft_entry__.dryrun_multichip(8)
