@@ -1,0 +1,17 @@
+from ddl25spring_tpu.fl.horizontal import (
+    CentralizedServer,
+    FedAvgServer,
+    FedSgdGradientServer,
+)
+from ddl25spring_tpu.fl.vertical import VFLNetwork
+from ddl25spring_tpu.fl.generative import TabularVAE, train_evaluator, tstr
+
+__all__ = [
+    "CentralizedServer",
+    "FedAvgServer",
+    "FedSgdGradientServer",
+    "VFLNetwork",
+    "TabularVAE",
+    "train_evaluator",
+    "tstr",
+]

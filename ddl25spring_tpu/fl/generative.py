@@ -1,0 +1,224 @@
+"""Generative FL: tabular VAE + TSTR evaluation.
+
+Capability parity with ``lab/tutorial_2a/generative-modeling.py``:
+
+- ``TabularVAE`` — the reference's ``Autoencoder`` (``:14-115``): BN+ReLU
+  Dense stacks D->H->H2->H2, latent mu/logvar heads, mirrored decoder with
+  a final BatchNorm and no activation; reparameterization in train mode;
+- ``vae_loss`` (in ``ops.losses``) — summed MSE + KLD (``customLoss``,
+  ``:118-127``);
+- ``sample`` — draws z from N(mu-bar, sigma-bar) aggregated over the train
+  set, decodes, clips+rounds the label column (``:105-115``);
+- ``tstr`` — Train-on-Synthetic-Test-on-Real: fit one evaluator on real and
+  one on synthetic data, compare real-test accuracy (``:164-208``).
+
+JAX notes: reparameterization uses explicit PRNG keys; BatchNorm stats live
+in a ``batch_stats`` collection threaded through the train step (the
+reference's ``self.training`` switch maps to ``use_running_average``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddl25spring_tpu.models.heart_mlp import HeartDiseaseNN
+from ddl25spring_tpu.ops.losses import cross_entropy_logits, vae_loss
+
+
+class Encoder(nn.Module):
+    h: int
+    h2: int
+    latent: int
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        for width in (self.h, self.h2, self.h2, self.latent):
+            x = nn.Dense(width)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x)
+        mu = nn.Dense(self.latent)(x)
+        logvar = nn.Dense(self.latent)(x)
+        return mu, logvar
+
+
+class Decoder(nn.Module):
+    d_out: int
+    h: int
+    h2: int
+    latent: int
+
+    @nn.compact
+    def __call__(self, z, *, train: bool):
+        for width in (self.latent, self.h2, self.h2, self.h):
+            z = nn.Dense(width)(z)
+            z = nn.BatchNorm(use_running_average=not train, momentum=0.9)(z)
+            z = nn.relu(z)
+        z = nn.Dense(self.d_out)(z)
+        # final BatchNorm, no activation (lin_bn6, generative-modeling.py:76)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)(z)
+
+
+class VaeModule(nn.Module):
+    d_in: int
+    h: int = 48
+    h2: int = 32
+    latent: int = 16
+
+    def setup(self):
+        self.encoder = Encoder(self.h, self.h2, self.latent)
+        self.decoder = Decoder(self.d_in, self.h, self.h2, self.latent)
+
+    def __call__(self, x, *, train: bool, key=None):
+        mu, logvar = self.encoder(x, train=train)
+        if train:
+            std = jnp.exp(0.5 * logvar)
+            eps = jax.random.normal(key, std.shape)
+            z = mu + eps * std
+        else:
+            z = mu
+        return self.decoder(z, train=train), mu, logvar
+
+    def decode(self, z, *, train: bool = False):
+        return self.decoder(z, train=train)
+
+
+class TabularVAE:
+    """Trainer wrapper (parity: ``Autoencoder.train_with_settings`` +
+    ``sample``).  Reference defaults: H=48, H2=32, latent=16, Adam 1e-3,
+    200 epochs, batch 64 (``generative-modeling.py:147-156``)."""
+
+    def __init__(self, d_in: int, h: int = 48, h2: int = 32, latent: int = 16,
+                 lr: float = 1e-3, seed: int = 42):
+        self.module = VaeModule(d_in, h, h2, latent)
+        self.key = jax.random.PRNGKey(seed)
+        variables = self.module.init(
+            self.key, jnp.zeros((2, d_in)), train=True, key=self.key
+        )
+        self.params = variables["params"]
+        self.batch_stats = variables["batch_stats"]
+        self.tx = optax.adam(lr)
+        self.opt_state = self.tx.init(self.params)
+
+        @jax.jit
+        def train_step(params, batch_stats, opt_state, x, key):
+            def loss_fn(p):
+                (recon, mu, logvar), mutated = self.module.apply(
+                    {"params": p, "batch_stats": batch_stats},
+                    x,
+                    train=True,
+                    key=key,
+                    mutable=["batch_stats"],
+                )
+                return vae_loss(recon, x, mu, logvar), mutated["batch_stats"]
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+        self._train_step = train_step
+
+    def train_with_settings(
+        self, epochs: int, batch_size: int, data: np.ndarray,
+        verbose: bool = False,
+    ) -> list[float]:
+        n = len(data)
+        losses = []
+        for e in range(epochs):
+            total, nb = 0.0, 0
+            for lo in range(0, n, batch_size):
+                x = jnp.asarray(data[lo : lo + batch_size])
+                self.params, self.batch_stats, self.opt_state, loss = (
+                    self._train_step(
+                        self.params,
+                        self.batch_stats,
+                        self.opt_state,
+                        x,
+                        jax.random.fold_in(
+                            jax.random.fold_in(self.key, e), lo
+                        ),
+                    )
+                )
+                total += float(loss)
+                nb += 1
+            losses.append(total / nb)
+            if verbose:
+                print(f"epoch {e}: loss {losses[-1]:.3f}")
+        return losses
+
+    def encode_stats(self, data: np.ndarray):
+        _, mu, logvar = self.module.apply(
+            {"params": self.params, "batch_stats": self.batch_stats},
+            jnp.asarray(data),
+            train=False,
+        )
+        return mu, logvar
+
+    def sample(self, nr_samples: int, mu, logvar, key=None) -> np.ndarray:
+        """Synthesize rows; the last column is the label, clipped+rounded
+        (``generative-modeling.py:105-115``)."""
+        key = key if key is not None else jax.random.fold_in(self.key, 7)
+        sigma = jnp.exp(logvar / 2)
+        z = mu.mean(axis=0) + sigma.mean(axis=0) * jax.random.normal(
+            key, (nr_samples, mu.shape[-1])
+        )
+        pred = self.module.apply(
+            {"params": self.params, "batch_stats": self.batch_stats},
+            z,
+            train=False,
+            method=VaeModule.decode,
+        )
+        pred = np.array(pred)  # copy: np.asarray of a jax buffer is read-only
+        pred[:, -1] = np.clip(pred[:, -1], 0, 1).round()
+        return pred
+
+
+def train_evaluator(
+    x_train, y_train, x_test, y_test, epochs: int = 49, lr: float = 1e-3,
+    seed: int = 0,
+) -> float:
+    """Full-batch AdamW evaluator training, returns final real-test accuracy
+    (the reference's 49-epoch EvaluatorModel loops,
+    ``generative-modeling.py:171-208``)."""
+    model = HeartDiseaseNN()
+    params = model.init(jax.random.PRNGKey(seed), jnp.asarray(x_train[:1]))[
+        "params"
+    ]
+    tx = optax.adamw(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            return cross_entropy_logits(model.apply({"params": p}, x), y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    x = jnp.asarray(x_train)
+    y = jnp.asarray(y_train)
+    for _ in range(epochs):
+        params, opt_state, _ = step(params, opt_state, x, y)
+    logits = model.apply({"params": params}, jnp.asarray(x_test))
+    return float((logits.argmax(-1) == jnp.asarray(y_test)).mean())
+
+
+def tstr(
+    vae: TabularVAE, x_train, y_train, x_test, y_test, seed: int = 0
+) -> dict[str, float]:
+    """Train-on-Synthetic-Test-on-Real comparison
+    (``generative-modeling.py:150-208``)."""
+    real = np.concatenate([x_train, y_train[:, None].astype(np.float32)], axis=1)
+    mu, logvar = vae.encode_stats(real)
+    synth = vae.sample(len(real), mu, logvar)
+    acc_real = train_evaluator(x_train, y_train, x_test, y_test, seed=seed)
+    acc_synth = train_evaluator(
+        synth[:, :-1], synth[:, -1].astype(np.int32), x_test, y_test, seed=seed
+    )
+    return {"real": acc_real, "synthetic": acc_synth}

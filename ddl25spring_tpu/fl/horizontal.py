@@ -1,0 +1,309 @@
+"""Horizontal federated learning: FedSGD and FedAvg.
+
+Capability parity with the reference's HFL framework
+(``lab/tutorial_1a/hfl_complete.py:145-390``):
+
+- ``CentralizedServer`` — plain epoch training control (``:193-216``);
+- ``FedSgdGradientServer`` + gradient clients — each chosen client returns
+  the gradient of ONE full-batch pass; the server applies the weighted
+  average through its own SGD (``:233-312``);
+- ``FedAvgServer`` + weight clients — each chosen client runs E local
+  epochs of minibatch SGD and returns weights; the server takes the
+  sample-count-weighted average (``:316-390``).
+
+TPU-native design: the reference's sequential client loop (wall-timed with a
+``max`` to *model* parallelism, ``hfl_complete.py:294``) becomes a real
+``jax.vmap`` over a stacked client axis — all chosen clients train in one
+XLA program.  Client sampling stays host-side (``rng.choice``, ``:278``);
+per-(round, client) randomness uses ``jax.random.fold_in`` instead of the
+reference's arithmetic seed (``:289``).  Weighted aggregation
+(``:292,371``) is a dot product over the client axis.
+
+Padding note: clients' shards are padded to rectangular arrays by repeating
+their own examples (see ``data/splitter.stack_client_data``); aggregation
+weights use TRUE sample counts.  With the reference's IID splits shard sizes
+differ by <= 1, so padding is negligible; non-IID runs oversample small
+clients slightly within their local epochs only.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.data.splitter import split_indices, stack_client_data
+from ddl25spring_tpu.models.mnist_cnn import MnistCnn
+from ddl25spring_tpu.ops.losses import nll_loss
+from ddl25spring_tpu.utils.metrics import RunResult, fedavg_message_count
+from ddl25spring_tpu.utils.prng import client_round_key
+
+
+def _model_loss(model):
+    def loss_fn(params, x, y, key):
+        out = model.apply(
+            {"params": params}, x, train=True, rngs={"dropout": key}
+        )
+        return nll_loss(out, y)
+
+    return loss_fn
+
+
+class _HflBase:
+    """Shared plumbing: data splitting/stacking, eval, RunResult."""
+
+    def __init__(
+        self,
+        nr_clients: int,
+        client_fraction: float,
+        batch_size: int,
+        nr_local_epochs: int,
+        lr: float,
+        iid: bool = True,
+        seed: int = 10,
+        model=None,
+        data: dict | None = None,
+        algorithm: str = "",
+        stack_clients: bool = True,
+    ):
+        self.n = nr_clients
+        self.c = client_fraction
+        self.b = batch_size
+        self.e = nr_local_epochs
+        self.lr = lr
+        self.iid = iid
+        self.seed = seed
+        self.model = model or MnistCnn()
+        self.data = data or load_mnist()
+        self.rng = np.random.default_rng(seed)
+        self.base_key = jax.random.PRNGKey(seed)
+
+        if stack_clients:
+            splits = split_indices(self.data["y_train"], self.n, iid, seed)
+            self.cx, self.cy, self.counts = stack_client_data(
+                self.data["x_train"], self.data["y_train"], splits
+            )
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), self.data["x_train"][:1]
+        )["params"]
+        self.result = RunResult(
+            algorithm, self.n, self.c, self.b, self.e, lr
+        )
+        self._eval = jax.jit(
+            lambda p, x: self.model.apply({"params": p}, x, train=False)
+        )
+
+    @property
+    def clients_per_round(self) -> int:
+        # round(), not int(): 0.29*100 floats to 28.999... and the reference
+        # rounds (hfl_complete.py:278)
+        return max(1, round(self.c * self.n))
+
+    def sample_clients(self) -> np.ndarray:
+        """Without-replacement client choice per round
+        (``hfl_complete.py:278-279``)."""
+        return self.rng.choice(self.n, self.clients_per_round, replace=False)
+
+    def test_accuracy(self, batch: int = 10_000) -> float:
+        """Full test-set accuracy (reference tests on one 10k batch,
+        ``hfl_complete.py:172-183``)."""
+        x, y = self.data["x_test"], self.data["y_test"]
+        correct = 0
+        for lo in range(0, len(x), batch):
+            out = self._eval(self.params, jnp.asarray(x[lo : lo + batch]))
+            correct += int((out.argmax(-1) == y[lo : lo + batch]).sum())
+        return correct / len(x)
+
+    def round_message_count(self, round_idx: int) -> int:
+        return fedavg_message_count(round_idx, self.clients_per_round)
+
+    def _record(self, round_idx: int, wall: float) -> None:
+        self.result.wall_time.append(wall)
+        self.result.message_count.append(self.round_message_count(round_idx))
+        self.result.test_accuracy.append(self.test_accuracy())
+
+    def run(self, nr_rounds: int) -> RunResult:
+        for r in range(nr_rounds):
+            t0 = time.perf_counter()
+            self.round(r)
+            jax.block_until_ready(jax.tree.leaves(self.params)[0])
+            self._record(r, time.perf_counter() - t0)
+        return self.result
+
+    def round(self, r: int) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class CentralizedServer(_HflBase):
+    """Non-federated control: epoch training over the full train set
+    (parity: ``hfl_complete.py:193-216``; N=C=E fixed to 1)."""
+
+    def __init__(self, lr: float, batch_size: int, seed: int = 10, **kw):
+        super().__init__(
+            nr_clients=1,
+            client_fraction=1.0,
+            batch_size=batch_size,
+            nr_local_epochs=1,
+            lr=lr,
+            seed=seed,
+            algorithm="Centralized",
+            stack_clients=False,  # trains on the full set; no client shards
+            **kw,
+        )
+        loss_fn = _model_loss(self.model)
+        tx = optax.sgd(lr)
+        self.opt_state = tx.init(self.params)
+
+        @jax.jit
+        def train_step(params, opt_state, x, y, key):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y, key)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._step = train_step
+
+    def round_message_count(self, round_idx: int) -> int:
+        return 0  # nothing federated is sent (hfl_complete.py:214)
+
+    def round(self, r: int) -> None:
+        x, y = self.data["x_train"], self.data["y_train"]
+        n = (len(x) // self.b) * self.b
+        order = self.rng.permutation(len(x))[:n]
+        key = jax.random.fold_in(self.base_key, r)
+        for bi, lo in enumerate(range(0, n, self.b)):
+            idx = order[lo : lo + self.b]
+            self.params, self.opt_state, _ = self._step(
+                self.params,
+                self.opt_state,
+                jnp.asarray(x[idx]),
+                jnp.asarray(y[idx]),
+                jax.random.fold_in(key, bi),
+            )
+
+
+def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
+    """One client's local training: E epochs of minibatch SGD, as nested
+    scans (epochs over shuffled batches) — vmappable over the client axis.
+    Parity: ``WeightClient.update`` -> ``train_epoch``
+    (``hfl_complete.py:71-80,322-332``)."""
+    loss_fn = _model_loss(model)
+    tx = optax.sgd(lr)
+
+    def local_update(params, x, y, key):
+        max_n = x.shape[0]
+        b = max_n if batch_size == -1 else min(batch_size, max_n)
+        nb = max_n // b
+        opt_state = tx.init(params)
+
+        def epoch(carry, ekey):
+            params, opt_state = carry
+            perm = jax.random.permutation(jax.random.fold_in(ekey, 0), max_n)
+            xb = x[perm[: nb * b]].reshape((nb, b) + x.shape[1:])
+            yb = y[perm[: nb * b]].reshape((nb, b))
+
+            def bstep(carry, batch):
+                params, opt_state, i = carry
+                bx, by = batch
+                bkey = jax.random.fold_in(ekey, i + 1)
+                grads = jax.grad(loss_fn)(params, bx, by, bkey)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state, i + 1), None
+
+            (params, opt_state, _), _ = jax.lax.scan(
+                bstep, (params, opt_state, 0), (xb, yb)
+            )
+            return (params, opt_state), None
+
+        ekeys = jax.random.split(key, nr_epochs)
+        (params, _), _ = jax.lax.scan(epoch, (params, opt_state), ekeys)
+        return params
+
+    return local_update
+
+
+class FedAvgServer(_HflBase):
+    """FedAvg: chosen clients train locally for E epochs, server takes the
+    sample-count-weighted average of returned weights
+    (parity: ``hfl_complete.py:336-390``)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, algorithm="FedAvg", **kw)
+        local = _make_local_epochs_fn(self.model, self.lr, self.b, self.e)
+
+        @jax.jit
+        def fedavg_round(params, cx, cy, counts, keys):
+            # all chosen clients train in parallel on the client axis —
+            # the TPU-native version of the reference's max-over-times model
+            client_params = jax.vmap(local, in_axes=(None, 0, 0, 0))(
+                params, cx, cy, keys
+            )
+            w = counts / counts.sum()  # hfl_complete.py:370-372
+            return jax.tree.map(
+                lambda stacked: jnp.tensordot(w, stacked, axes=1),
+                client_params,
+            )
+
+        self._round = fedavg_round
+
+    def round(self, r: int) -> None:
+        chosen = self.sample_clients()
+        keys = jnp.stack(
+            [client_round_key(self.base_key, r, int(i)) for i in chosen]
+        )
+        self.params = self._round(
+            self.params,
+            jnp.asarray(self.cx[chosen]),
+            jnp.asarray(self.cy[chosen]),
+            jnp.asarray(self.counts[chosen], jnp.float32),
+            keys,
+        )
+
+
+class FedSgdGradientServer(_HflBase):
+    """FedSGD: chosen clients return one full-batch gradient; the server
+    applies the weighted average via its own SGD
+    (parity: ``hfl_complete.py:233-312``; full batch via ``batch_size=len``
+    at ``:235``)."""
+
+    def __init__(self, *args, **kw):
+        kw.setdefault("batch_size", -1)
+        kw.setdefault("nr_local_epochs", 1)
+        super().__init__(*args, algorithm="FedSGD", **kw)
+        loss_fn = _model_loss(self.model)
+        tx = optax.sgd(self.lr)
+        self.opt_state = tx.init(self.params)
+
+        @jax.jit
+        def fedsgd_round(params, opt_state, cx, cy, counts, keys):
+            def client_grad(params, x, y, key):
+                return jax.grad(loss_fn)(params, x, y, key)
+
+            grads = jax.vmap(client_grad, in_axes=(None, 0, 0, 0))(
+                params, cx, cy, keys
+            )
+            w = counts / counts.sum()
+            avg = jax.tree.map(
+                lambda g: jnp.tensordot(w, g, axes=1), grads
+            )
+            updates, opt_state = tx.update(avg, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._round = fedsgd_round
+
+    def round(self, r: int) -> None:
+        chosen = self.sample_clients()
+        keys = jnp.stack(
+            [client_round_key(self.base_key, r, int(i)) for i in chosen]
+        )
+        self.params, self.opt_state = self._round(
+            self.params,
+            self.opt_state,
+            jnp.asarray(self.cx[chosen]),
+            jnp.asarray(self.cy[chosen]),
+            jnp.asarray(self.counts[chosen], jnp.float32),
+            keys,
+        )

@@ -1,0 +1,163 @@
+"""Vertical federated learning (split-NN).
+
+Capability parity with ``lab/tutorial_2b/vfl.py``: K parties each own a
+disjoint **feature** slice; each runs a ``BottomModel``
+(Linear -> ReLU -> Linear -> ReLU -> Dropout, ``vfl.py:11-22``); the server's
+``TopModel`` concatenates the party activations and classifies
+(128 -> 256 -> 2 with LeakyReLU, ``vfl.py:25-40``); one joint AdamW over all
+parties' params (``vfl.py:50``), so gradients cross the party boundary
+through the concat — the cut layer.
+
+TPU-native design: the party boundary is kept EXPLICIT as a list of
+cut-layer activations (the real VFL communication surface), but the whole
+split network is one jitted ``jax.grad`` — party count is static, so the
+per-party bottom models are a compile-time Python loop (ragged feature
+widths need no padding).  Reference bug *not* replicated: the reference's
+TopModel applies LeakyReLU+Dropout to its final logits (``vfl.py:38-40``);
+here logits come out raw, which is what CrossEntropyLoss expects.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddl25spring_tpu.ops.losses import cross_entropy_logits
+
+
+class BottomModel(nn.Module):
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.relu(nn.Dense(self.out_dim)(x))
+        x = nn.relu(nn.Dense(self.out_dim)(x))
+        return nn.Dropout(0.1, deterministic=not train)(x)
+
+
+class TopModel(nn.Module):
+    n_outs: int = 2
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.leaky_relu(nn.Dense(128)(x))
+        x = nn.leaky_relu(nn.Dense(256)(x))
+        x = nn.Dropout(0.1, deterministic=not train)(x)
+        return nn.Dense(self.n_outs)(x)
+
+
+class VFLNetwork:
+    """Joint split-network trainer (parity: ``VFLNetwork``,
+    ``vfl.py:43-102``).
+
+    ``feature_indices``: per-party encoded-column index arrays (from
+    ``data.heart.partition_features``).  ``outs_per_feature=2`` mirrors the
+    reference's ``outs_per_client * len(in_feats)`` bottom widths
+    (``vfl.py:148``).
+    """
+
+    def __init__(
+        self,
+        feature_indices: list[np.ndarray],
+        n_outs: int = 2,
+        outs_per_feature: int = 2,
+        lr: float = 1e-3,
+        seed: int = 42,
+    ):
+        self.feature_indices = [np.asarray(f) for f in feature_indices]
+        self.n_parties = len(feature_indices)
+        self.bottoms = [
+            BottomModel(outs_per_feature * len(f)) for f in self.feature_indices
+        ]
+        self.top = TopModel(n_outs)
+        self.key = jax.random.PRNGKey(seed)
+
+        keys = jax.random.split(self.key, self.n_parties + 1)
+        self.params = {
+            "bottoms": [
+                m.init(k, jnp.zeros((1, len(f))))["params"]
+                for m, k, f in zip(self.bottoms, keys[:-1], self.feature_indices)
+            ],
+            "top": self.top.init(
+                keys[-1],
+                jnp.zeros((1, sum(m.out_dim for m in self.bottoms))),
+            )["params"],
+        }
+        # reference uses torch AdamW defaults (vfl.py:50)
+        self.tx = optax.adamw(lr)
+        self.opt_state = self.tx.init(self.params)
+
+        def forward(params, xs: list[jax.Array], key, train: bool):
+            # the CUT LAYER: per-party activations, then concat (vfl.py:36)
+            acts = []
+            for i, (m, x) in enumerate(zip(self.bottoms, xs)):
+                acts.append(
+                    m.apply(
+                        {"params": params["bottoms"][i]},
+                        x,
+                        train=train,
+                        rngs={"dropout": jax.random.fold_in(key, i)},
+                    )
+                )
+            joined = jnp.concatenate(acts, axis=1)
+            return self.top.apply(
+                {"params": params["top"]},
+                joined,
+                train=train,
+                rngs={"dropout": jax.random.fold_in(key, self.n_parties)},
+            )
+
+        self._forward = forward
+
+        @jax.jit
+        def train_step(params, opt_state, xs, y, key):
+            def loss_fn(p):
+                logits = forward(p, xs, key, True)
+                return cross_entropy_logits(logits, y)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._train_step = train_step
+
+    def _slice(self, x: np.ndarray) -> list[jax.Array]:
+        return [jnp.asarray(x[:, f]) for f in self.feature_indices]
+
+    def train_with_settings(
+        self, epochs: int, batch_size: int, x: np.ndarray, y: np.ndarray,
+        verbose: bool = False,
+    ) -> list[float]:
+        """Minibatch joint training (parity: ``train_with_settings``,
+        ``vfl.py:53-85``; per-batch optimizer step)."""
+        n = len(x)
+        losses = []
+        for e in range(epochs):
+            total = 0.0
+            nb = 0
+            for lo in range(0, n, batch_size):
+                xs = self._slice(x[lo : lo + batch_size])
+                yb = jnp.asarray(y[lo : lo + batch_size])
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params,
+                    self.opt_state,
+                    xs,
+                    yb,
+                    jax.random.fold_in(jax.random.fold_in(self.key, e), lo),
+                )
+                total += float(loss)
+                nb += 1
+            losses.append(total / nb)
+            if verbose:
+                print(f"epoch {e}: loss {losses[-1]:.4f}")
+        return losses
+
+    def test(self, x: np.ndarray, y: np.ndarray) -> tuple[float, float]:
+        """Accuracy + mean loss on held-out data (``vfl.py:91-102``)."""
+        logits = self._forward(self.params, self._slice(x), self.key, False)
+        loss = float(cross_entropy_logits(logits, jnp.asarray(y)))
+        acc = float((logits.argmax(-1) == jnp.asarray(y)).mean())
+        return acc, loss

@@ -1,0 +1,133 @@
+"""Horizontal FL tests.
+
+Centerpiece: the homework-A1 equivalence oracle — FedSGD-with-gradients must
+equal FedAvg-with-weights at ``B=-1, E=1`` (``lab/series01.ipynb`` cells 9-12;
+tolerance 0.02% there, exact up to fp32 here with dropout disabled, since
+weight-averaging after one full-batch SGD step is linear in the gradients).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.fl import CentralizedServer, FedAvgServer, FedSgdGradientServer
+
+
+class TinyMlp(nn.Module):
+    """Dropout-free model for exact-equivalence tests (full MnistCnn under
+    vmapped scans compiles for minutes on the CPU test backend)."""
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.log_softmax(nn.Dense(10)(x))
+
+
+class TinyDropoutMlp(nn.Module):
+    """Small model WITH dropout: exercises per-client rng plumbing."""
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(32)(x))
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        return nn.log_softmax(nn.Dense(10)(x))
+
+
+@pytest.fixture(scope="module")
+def small_data():
+    return load_mnist(n_train=1000, n_test=500)
+
+
+def test_a1_fedsgd_equals_fedavg_fullbatch(small_data):
+    kw = dict(
+        nr_clients=5,
+        client_fraction=0.4,
+        lr=0.05,
+        seed=10,
+        model=TinyMlp(),
+        data=small_data,
+    )
+    sgd = FedSgdGradientServer(batch_size=-1, nr_local_epochs=1, **kw)
+    avg = FedAvgServer(batch_size=-1, nr_local_epochs=1, **kw)
+    r_sgd = sgd.run(3)
+    r_avg = avg.run(3)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-5, rtol=1e-4
+        ),
+        sgd.params,
+        avg.params,
+    )
+    # the reference's tolerance on the metric itself
+    np.testing.assert_allclose(
+        r_sgd.test_accuracy, r_avg.test_accuracy, atol=2e-4
+    )
+
+
+def test_fedavg_learns_and_counts_messages(small_data):
+    server = FedAvgServer(
+        nr_clients=10,
+        client_fraction=0.5,
+        batch_size=50,
+        nr_local_epochs=2,
+        lr=0.05,
+        seed=10,
+        model=TinyDropoutMlp(),
+        data=small_data,
+    )
+    res = server.run(3)
+    assert res.test_accuracy[-1] > 0.6  # synthetic data is easy
+    assert res.message_count == [10, 20, 30]  # 2*(r+1)*5
+    df = res.as_df()
+    assert list(df["Round"]) == [1, 2, 3]
+    assert df["Algorithm"].iloc[0] == "FedAvg"
+
+
+def test_fedavg_noniid_runs(small_data):
+    server = FedAvgServer(
+        nr_clients=5,
+        client_fraction=0.6,
+        batch_size=20,
+        nr_local_epochs=1,
+        lr=0.05,
+        iid=False,
+        seed=10,
+        model=TinyMlp(),
+        data=small_data,
+    )
+    res = server.run(2)
+    assert len(res.test_accuracy) == 2
+
+
+def test_fedavg_seed_determinism(small_data):
+    mk = lambda: FedAvgServer(
+        nr_clients=5,
+        client_fraction=0.4,
+        batch_size=50,
+        nr_local_epochs=1,
+        lr=0.05,
+        seed=10,
+        model=TinyDropoutMlp(),
+        data=small_data,
+    )
+    a, b = mk(), mk()
+    a.run(2)
+    b.run(2)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            jax.device_get(x), jax.device_get(y)
+        ),
+        a.params,
+        b.params,
+    )
+
+
+def test_centralized_learns(small_data):
+    server = CentralizedServer(lr=0.05, batch_size=50, seed=10, data=small_data)
+    res = server.run(2)
+    assert res.test_accuracy[-1] > 0.8

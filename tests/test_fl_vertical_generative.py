@@ -1,0 +1,67 @@
+"""VFL (split-NN) + generative-FL (VAE/TSTR) tests on the heart workload."""
+
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.data.heart import load_heart, partition_features
+from ddl25spring_tpu.fl.generative import TabularVAE, train_evaluator, tstr
+from ddl25spring_tpu.fl.vertical import VFLNetwork
+
+
+@pytest.fixture(scope="module")
+def heart():
+    return load_heart(n_synthetic=600, seed=42)
+
+
+def test_heart_loader_schema(heart):
+    assert heart["x"].shape[1] == len(heart["feature_names"])
+    assert heart["x"].shape[1] >= 26  # 5 numericals + one-hot categoricals
+    assert set(np.unique(heart["y"])) <= {0, 1}
+    # slices cover the matrix disjointly
+    spans = sorted(heart["feature_slices"].values())
+    assert spans[0][0] == 0 and spans[-1][1] == heart["x"].shape[1]
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c
+
+
+def test_partition_features_disjoint_covering(heart):
+    parts = partition_features(heart["feature_slices"], 4)
+    assert len(parts) == 4
+    allidx = np.concatenate(parts)
+    assert len(allidx) == heart["x"].shape[1]
+    assert len(np.unique(allidx)) == len(allidx)
+
+
+def test_vfl_trains_above_chance(heart):
+    x, y = heart["x"], heart["y"]
+    n = int(0.8 * len(x))
+    parts = partition_features(heart["feature_slices"], 4)
+    net = VFLNetwork(parts, lr=1e-3, seed=42)
+    losses = net.train_with_settings(30, 64, x[:n], y[:n])
+    assert losses[-1] < losses[0]
+    acc, loss = net.test(x[n:], y[n:])
+    base = max(np.mean(y[n:]), 1 - np.mean(y[n:]))
+    assert acc > base - 0.05  # beats/approaches majority class
+
+
+def test_vae_loss_decreases_and_samples(heart):
+    x, y = heart["x"], heart["y"]
+    real = np.concatenate([x, y[:, None].astype(np.float32)], axis=1)
+    vae = TabularVAE(d_in=real.shape[1], seed=42)
+    losses = vae.train_with_settings(20, 64, real)
+    assert losses[-1] < losses[0]
+    mu, logvar = vae.encode_stats(real)
+    synth = vae.sample(100, mu, logvar)
+    assert synth.shape == (100, real.shape[1])
+    assert set(np.unique(synth[:, -1])) <= {0.0, 1.0}  # label clipped+rounded
+
+
+def test_tstr_harness(heart):
+    x, y = heart["x"], heart["y"]
+    n = int(0.8 * len(x))
+    vae = TabularVAE(d_in=x.shape[1] + 1, seed=42)
+    vae.train_with_settings(10, 64, np.concatenate(
+        [x[:n], y[:n, None].astype(np.float32)], axis=1))
+    res = tstr(vae, x[:n], y[:n], x[n:], y[n:])
+    assert 0.0 <= res["synthetic"] <= 1.0
+    assert res["real"] > 0.6  # evaluator learns the real data
