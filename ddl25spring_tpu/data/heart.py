@@ -77,6 +77,13 @@ def _read_csv(path: Path) -> dict[str, np.ndarray]:
     }
 
 
+def _freeze(d: dict) -> dict:
+    for v in d.values():
+        if isinstance(v, np.ndarray):
+            v.flags.writeable = False  # lru_cache shares the dict: no aliasing bugs
+    return d
+
+
 @lru_cache(maxsize=4)
 def load_heart(
     n_synthetic: int = 1025, seed: int = 42, scale: str = "minmax"
@@ -112,7 +119,9 @@ def load_heart(
 
     x = np.concatenate(cols, axis=1).astype(np.float32)
     y = raw["target"].astype(np.int32)
-    return {"x": x, "y": y, "feature_names": names, "feature_slices": slices}
+    return _freeze(
+        {"x": x, "y": y, "feature_names": names, "feature_slices": slices}
+    )
 
 
 def partition_features(

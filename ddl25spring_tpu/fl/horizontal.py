@@ -21,9 +21,11 @@ reference's arithmetic seed (``:289``).  Weighted aggregation
 
 Padding note: clients' shards are padded to rectangular arrays by repeating
 their own examples (see ``data/splitter.stack_client_data``); aggregation
-weights use TRUE sample counts.  With the reference's IID splits shard sizes
-differ by <= 1, so padding is negligible; non-IID runs oversample small
-clients slightly within their local epochs only.
+weights use TRUE sample counts.  FedSGD's full-batch gradient masks the pad
+rows (so it is the exact gradient over the client's real shard, matching the
+reference's ``batch_size=len(data)`` semantics); FedAvg's local epochs see
+the repeats, a slight oversampling of small clients confined to their own
+local training.
 """
 
 from __future__ import annotations
@@ -84,9 +86,14 @@ class _HflBase:
 
         if stack_clients:
             splits = split_indices(self.data["y_train"], self.n, iid, seed)
-            self.cx, self.cy, self.counts = stack_client_data(
+            cx, cy, self.counts = stack_client_data(
                 self.data["x_train"], self.data["y_train"], splits
             )
+            # device-resident once: rounds select clients with a device-side
+            # take instead of re-uploading the stacked set every round
+            self.cx = jnp.asarray(cx)
+            self.cy = jnp.asarray(cy)
+            self.counts_dev = jnp.asarray(self.counts, jnp.float32)
         self.params = self.model.init(
             jax.random.PRNGKey(seed), self.data["x_train"][:1]
         )["params"]
@@ -254,11 +261,12 @@ class FedAvgServer(_HflBase):
         keys = jnp.stack(
             [client_round_key(self.base_key, r, int(i)) for i in chosen]
         )
+        idx = jnp.asarray(chosen)
         self.params = self._round(
             self.params,
-            jnp.asarray(self.cx[chosen]),
-            jnp.asarray(self.cy[chosen]),
-            jnp.asarray(self.counts[chosen], jnp.float32),
+            jnp.take(self.cx, idx, axis=0),
+            jnp.take(self.cy, idx, axis=0),
+            jnp.take(self.counts_dev, idx, axis=0),
             keys,
         )
 
@@ -273,17 +281,27 @@ class FedSgdGradientServer(_HflBase):
         kw.setdefault("batch_size", -1)
         kw.setdefault("nr_local_epochs", 1)
         super().__init__(*args, algorithm="FedSGD", **kw)
-        loss_fn = _model_loss(self.model)
         tx = optax.sgd(self.lr)
         self.opt_state = tx.init(self.params)
 
         @jax.jit
         def fedsgd_round(params, opt_state, cx, cy, counts, keys):
-            def client_grad(params, x, y, key):
-                return jax.grad(loss_fn)(params, x, y, key)
+            def client_grad(params, x, y, count, key):
+                # mask the tail pad rows (repeats from stack_client_data) so
+                # this is the exact full-shard gradient, per the reference's
+                # batch_size=len(data) FedSGD (hfl_complete.py:235)
+                def masked_loss(p):
+                    out = self.model.apply(
+                        {"params": p}, x, train=True, rngs={"dropout": key}
+                    ).astype(jnp.float32)
+                    picked = jnp.take_along_axis(out, y[:, None], -1)[:, 0]
+                    real = jnp.arange(x.shape[0]) < count
+                    return -(picked * real).sum() / count
 
-            grads = jax.vmap(client_grad, in_axes=(None, 0, 0, 0))(
-                params, cx, cy, keys
+                return jax.grad(masked_loss)(params)
+
+            grads = jax.vmap(client_grad, in_axes=(None, 0, 0, 0, 0))(
+                params, cx, cy, counts, keys
             )
             w = counts / counts.sum()
             avg = jax.tree.map(
@@ -299,11 +317,12 @@ class FedSgdGradientServer(_HflBase):
         keys = jnp.stack(
             [client_round_key(self.base_key, r, int(i)) for i in chosen]
         )
+        idx = jnp.asarray(chosen)
         self.params, self.opt_state = self._round(
             self.params,
             self.opt_state,
-            jnp.asarray(self.cx[chosen]),
-            jnp.asarray(self.cy[chosen]),
-            jnp.asarray(self.counts[chosen], jnp.float32),
+            jnp.take(self.cx, idx, axis=0),
+            jnp.take(self.cy, idx, axis=0),
+            jnp.take(self.counts_dev, idx, axis=0),
             keys,
         )

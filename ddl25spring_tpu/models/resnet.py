@@ -72,6 +72,30 @@ class ResNetBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+def block_plan(width: int) -> list[tuple[int, int]]:
+    """The single (filters, stride) sequence all ResNet-18 variants below
+    share — the monolithic net and the pipeline stage split cannot drift."""
+    w = width
+    return [
+        (w, 1), (w, 1),
+        (2 * w, 2), (2 * w, 1),
+        (4 * w, 2), (4 * w, 1),
+        (8 * w, 2), (8 * w, 1),
+    ]
+
+
+STAGE_CUT = 4  # blocks 0:4 -> stage 0, 4:8 -> stage 1 (the 2-stage PP split)
+
+
+def _stem(x, width, norm, dtype, train):
+    y = nn.Conv(width, (3, 3), padding="SAME", use_bias=False, dtype=dtype)(x)
+    if norm == "batch":
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=dtype)(y)
+    else:
+        y = nn.GroupNorm(num_groups=min(32, width // 4), dtype=dtype)(y)
+    return nn.relu(y)
+
+
 class ResNet18(nn.Module):
     num_classes: int = 10
     norm: str = "batch"
@@ -80,25 +104,48 @@ class ResNet18(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        w = self.width
-        y = nn.Conv(w, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
-        if self.norm == "batch":
-            y = nn.BatchNorm(
-                use_running_average=not train, momentum=0.9, dtype=self.dtype
-            )(y)
-        else:
-            y = nn.GroupNorm(num_groups=min(32, w // 4), dtype=self.dtype)(y)
-        y = nn.relu(y)
-        for gi, (filters, stride) in enumerate(
-            [(w, 1), (2 * w, 2), (4 * w, 2), (8 * w, 2)]
-        ):
-            for bi in range(2):
-                y = ResNetBlock(
-                    filters,
-                    strides=stride if bi == 0 else 1,
-                    norm=self.norm,
-                    dtype=self.dtype,
-                )(y, train=train)
+        y = _stem(x, self.width, self.norm, self.dtype, train)
+        for filters, stride in block_plan(self.width):
+            y = ResNetBlock(
+                filters, strides=stride, norm=self.norm, dtype=self.dtype
+            )(y, train=train)
         y = jnp.mean(y, axis=(1, 2))
         y = nn.Dense(self.num_classes, dtype=jnp.float32)(y)
         return y
+
+
+class ResNet18Stage0(nn.Module):
+    """Pipeline stage 0: stem + ``block_plan[:STAGE_CUT]``.
+
+    Output boundary: ``[B, 16, 16, 2*width]`` for 32x32 inputs — the single
+    activation shape crossing the stage cut in the 2-stage DP+PP benchmark
+    topology (BASELINE.json config "2-stage pipeline x 2-way DP").  Uses
+    GroupNorm (stateless) so the pipeline step carries no mutable batch
+    statistics across the scanned schedule.
+    """
+
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = _stem(x, self.width, "group", self.dtype, False)
+        for filters, stride in block_plan(self.width)[:STAGE_CUT]:
+            y = ResNetBlock(filters, strides=stride, norm="group", dtype=self.dtype)(y)
+        return y
+
+
+class ResNet18Stage1(nn.Module):
+    """Pipeline stage 1: ``block_plan[STAGE_CUT:]`` + pool + classifier."""
+
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = x
+        for filters, stride in block_plan(self.width)[STAGE_CUT:]:
+            y = ResNetBlock(filters, strides=stride, norm="group", dtype=self.dtype)(y)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(y)

@@ -1,0 +1,193 @@
+"""GPipe pipeline for HETEROGENEOUS stages (e.g. ResNet-18 DP+PP).
+
+:mod:`ddl25spring_tpu.parallel.pipeline` handles the reference's LLaMA
+workload, where every pipeline stage is the same block structure and the
+stage split is a reshape of stacked layer params.  Convolutional nets
+(the BASELINE.json benchmark config, ResNet-18/CIFAR-10 DP+PP) break both
+assumptions the homogeneous path relies on:
+
+- per-stage params have *different* pytree structures/shapes, so they cannot
+  be stacked ``[S, ...]`` and sharded over the ``stage`` axis;
+- stage-boundary activations have *different* shapes (channel/spatial dims
+  change at downsampling groups), so a single ``ppermute`` buffer of one
+  shape cannot carry them.
+
+Design here (same one-program SPMD GPipe schedule as the LLaMA path):
+
+- per-stage params are passed **replicated**; each device executes only its
+  own stage's compute via ``lax.switch`` on the stage index.  The memory cost
+  (every chip holds all stages' params) is the price of heterogeneity and is
+  irrelevant at ResNet-18 scale; the FLOPs and activation memory — the actual
+  pipeline motivation — still split S ways.
+- boundary activations travel in one flat ``[mb, max_boundary]`` buffer;
+  each stage unflattens its input slice and flattens/zero-pads its output.
+  The ``ppermute`` hop between stages is then shape-uniform.
+- microbatch grad accumulation, the bubble schedule (T = M + S - 1 ticks),
+  and the DP dimension are identical to the homogeneous path: losses sum in
+  the scan carry and the cotangent ``psum`` over ``data`` is automatic.
+
+Parity anchors: the reference's microbatch schedule + per-stage-group
+all_reduce (``lab/s01_b1_microbatches.py:66-178``,
+``lab/s01_b2_dp_pp.py:93-227``), retargeted at the conv benchmark workload.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Any
+StageFn = Callable[[Params, jax.Array], jax.Array]
+
+
+def _flat_size(shape: Sequence[int]) -> int:
+    return math.prod(shape[1:])  # per-example size (dim 0 is the microbatch)
+
+
+def make_het_pipeline_loss(
+    stage_fns: Sequence[StageFn],
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    in_shape: Sequence[int],
+    boundary_shapes: Sequence[Sequence[int]],
+    mesh: Mesh,
+    num_microbatches: int,
+    inject_fn: Callable[[Any], jax.Array] | None = None,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+    compute_dtype: Any = jnp.float32,
+):
+    """Build ``loss(params_per_stage, batch) -> scalar`` for S heterogeneous
+    stages on the mesh ``stage`` axis.
+
+    ``stage_fns[i]``: ``(params_i, x_i) -> x_{i+1}`` with ``x_0`` of shape
+    ``in_shape`` and ``x_{i+1}`` of shape ``boundary_shapes[i]`` (all shapes
+    include the microbatch dim; ``boundary_shapes[-1]`` is the final output
+    fed to ``loss_fn(final, mb_batch)``).
+
+    ``batch`` is a pytree whose leaves lead with the global batch dim
+    ``B = num_microbatches * mb * data_parallelism``; ``inject_fn(mb_batch)``
+    extracts stage-0's input (default: the batch's ``"x"`` entry).
+    """
+    S = len(stage_fns)
+    assert S == mesh.shape[stage_axis], (S, mesh.shape)
+    M = num_microbatches
+    shapes = [tuple(in_shape)] + [tuple(s) for s in boundary_shapes]
+    mb = shapes[0][0]
+    assert all(s[0] == mb for s in shapes), f"microbatch dims differ: {shapes}"
+    # stage 0 injects its input from the batch and never reads the buffer,
+    # so only the S boundary shapes size the ppermute hop
+    buf_elems = max(_flat_size(s) for s in shapes[1:])
+    inject = inject_fn if inject_fn is not None else (lambda b: b["x"])
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, data_axis)),
+        out_specs=P(),
+    )
+    def pipelined(params, batch_mb):
+        s = lax.axis_index(stage_axis)
+        axes = (stage_axis,) + ((data_axis,) if data_axis else ())
+        # varying copies so the transpose's cotangent psum over the stage
+        # axis runs uniformly on every device (not inside switch branches)
+        vparams = lax.pcast(params, axes, to="varying")
+
+        def pack(x):
+            flat = x.reshape(mb, -1).astype(compute_dtype)
+            pad = buf_elems - flat.shape[1]
+            return jnp.pad(flat, ((0, 0), (0, pad))) if pad else flat
+
+        def unpack(buf, shape):
+            return buf[:, : _flat_size(shape)].reshape(shape)
+
+        def tick(carry, t):
+            buf_in, loss_sum = carry
+            mb_t = jax.tree.map(lambda x: x[jnp.minimum(t, M - 1)], batch_mb)
+
+            def branch(i):
+                def run(buf):
+                    if i == 0:
+                        x = inject(mb_t).astype(compute_dtype)
+                    else:
+                        x = unpack(buf, shapes[i])
+                    return pack(stage_fns[i](vparams[i], x))
+
+                return run
+
+            buf_out = lax.switch(s, [branch(i) for i in range(S)], buf_in)
+
+            done = t - (S - 1)
+            mb_done = jax.tree.map(
+                lambda x: x[jnp.clip(done, 0, M - 1)], batch_mb
+            )
+            loss_mb = lax.cond(
+                jnp.logical_and(s == S - 1, done >= 0),
+                lambda b, y: loss_fn(unpack(b, shapes[S]).astype(jnp.float32), y),
+                lambda b, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                buf_out,
+                mb_done,
+            )
+
+            outgoing = lax.ppermute(
+                buf_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (outgoing, loss_sum + loss_mb), None
+
+        carry0 = (
+            lax.pcast(
+                jnp.zeros((mb, buf_elems), compute_dtype), axes, to="varying"
+            ),
+            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+        )
+        (_, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
+
+        total = lax.psum(loss_sum, stage_axis) / M
+        if data_axis is not None:
+            total = lax.pmean(total, data_axis)
+        return total
+
+    def loss(params, batch):
+        leaves = jax.tree.leaves(batch)
+        B = leaves[0].shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        batch_mb = jax.tree.map(
+            lambda x: x.reshape((M, B // M) + x.shape[1:]), batch
+        )
+        return pipelined(params, batch_mb)
+
+    return loss
+
+
+def make_het_pipeline_train_step(
+    stage_fns: Sequence[StageFn],
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    in_shape: Sequence[int],
+    boundary_shapes: Sequence[Sequence[int]],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    num_microbatches: int,
+    **kw,
+):
+    """Jitted DPxPP train step over heterogeneous stages (the benchmark
+    topology: 2-stage ResNet pipeline x DP with microbatches)."""
+    pipe_loss = make_het_pipeline_loss(
+        stage_fns, loss_fn, in_shape, boundary_shapes, mesh,
+        num_microbatches, **kw,
+    )
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(pipe_loss)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,13 @@
+#!/bin/bash
+
+# Part B1: the GPipe microbatch pipeline (reference: 3 gloo processes,
+# lab/run-b1.sh:8-15). TPU-native: ONE single-controller process — the
+# pipeline stages are mesh devices inside one jitted SPMD program, so there
+# is no per-rank spawn loop, no out<rank>.txt fan-out, and no rendezvous.
+
+cd "$(dirname "$0")" || return
+START_TIME=$SECONDS
+
+python -u s01_b1_microbatches.py "$@"
+
+echo "Elapsed time (s): $((SECONDS - START_TIME))"

@@ -1,0 +1,16 @@
+#!/bin/bash
+
+# Part B2: DP + PP with micro-batches (reference: 6 gloo processes — two
+# 3-stage pipelines with per-stage DP groups, lab/run-b2.sh:8-15). TPU-native:
+# ONE single-controller process over a 2-D (data, stage) device mesh.
+#
+# Default workload is the BASELINE.json benchmark config (ResNet-18/CIFAR-10,
+# samples/sec/chip vs the >=5k north star); pass "--workload llama" for the
+# reference's original LLaMA-on-TinyStories DPxPP run.
+
+cd "$(dirname "$0")" || return
+START_TIME=$SECONDS
+
+python -u s01_b2_dp_pp.py "$@"
+
+echo "Elapsed time (s): $((SECONDS - START_TIME))"

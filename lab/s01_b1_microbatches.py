@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Homework B1 — GPipe microbatch pipeline, TPU-native.
+
+The reference runs this as THREE OS processes (``python s01_b1_microbatches.py
+<rank>``, ``lab/run-b1.sh:8-15``), each holding one LLaMA stage and chaining
+``isend/irecv`` with per-microbatch tags (``lab/s01_b1_microbatches.py:66-178``).
+Here the same workload — the reference constants dmodel=288, 6 heads, 6 layers,
+ctx 256, batch 3 split into 3 microbatches, Adam — is ONE jitted SPMD program:
+stages live on a mesh ``stage`` axis, the microbatch schedule is a ``lax.scan``
+of ``ppermute`` hops, and backward/grad-accumulation fall out of ``jax.grad``.
+
+Single-controller launch: no rank argv, no MASTER_ADDR/PORT rendezvous.  On a
+host without 3 accelerator devices, ``--force-cpu-devices N`` simulates the
+mesh on CPU (the TPU-world analogue of the reference's gloo-on-localhost runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=200,
+                    help="outer iterations (reference: 5000)")
+    ap.add_argument("--batch", type=int, default=3,
+                    help="global batch size (reference: 3)")
+    ap.add_argument("--microbatches", type=int, default=3,
+                    help="microbatches per batch (reference: 3)")
+    ap.add_argument("--stages", type=int, default=0,
+                    help="pipeline stages; 0 = largest divisor of n_layers "
+                         "that fits the device count (reference: 3)")
+    ap.add_argument("--lr", type=float, default=8e-4)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
+                    help="simulate an N-device mesh on CPU")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    if args.force_cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+        ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from ddl25spring_tpu.data.tinystories import TinyStories
+    from ddl25spring_tpu.data.tokenizer import get_tokenizer
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel.pipeline import (
+        make_pipeline_train_step,
+        shard_staged_params,
+    )
+    from ddl25spring_tpu.utils.config import LlamaConfig
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    devices = jax.devices()
+    tokenizer = get_tokenizer()
+    cfg = LlamaConfig(
+        vocab_size=tokenizer.vocab_size, dmodel=288, num_heads=6,
+        n_layers=6, ctx_size=args.seq_len,
+        dtype="bfloat16" if devices[0].platform == "tpu" else "float32",
+    )
+    S = args.stages or max(
+        s for s in (6, 3, 2, 1) if s <= len(devices) and cfg.n_layers % s == 0
+    )
+    mesh = make_mesh(devices[:S], stage=S)
+    print(f"devices={len(devices)} ({devices[0].platform}) -> "
+          f"pipeline stages={S}, microbatches={args.microbatches}, "
+          f"batch={args.batch}")
+
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(staged)
+    step = make_pipeline_train_step(cfg, tx, mesh, args.microbatches)
+
+    ds = iter(TinyStories(tokenizer, batch_size=args.batch, seq_l=args.seq_len))
+    t0 = time.perf_counter()
+    for it in range(args.iters):
+        tokens = jnp.asarray(next(ds))
+        staged, opt_state, loss = step(staged, opt_state, tokens)
+        if it % args.log_every == 0 or it == args.iters - 1:
+            # host transfer forces completion of the async dispatch chain
+            print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    tok_s = args.iters * args.batch * args.seq_len / dt
+    print(f"done: {args.iters} iters in {dt:.1f}s "
+          f"({tok_s:,.0f} tok/s, {tok_s / len(mesh.devices.flat):,.0f} tok/s/chip)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Homework B2 — DP x PP hybrid, TPU-native.
+
+The reference runs SIX processes — two 3-stage pipelines {0,1,2}/{3,4,5} with
+per-stage DP groups {0,3},{1,4},{2,5} built via ``dist.new_group``, microbatch
+``isend/irecv`` chains, then barrier + flatten + per-group ``all_reduce(SUM)``
++ unflatten/2 + Adam step (``lab/s01_b2_dp_pp.py``).  Here the whole topology
+is ONE jitted program over a 2-D mesh ``(data, stage)``: the per-stage DP
+groups ARE the ``data`` axis, the pipelines ARE the ``stage`` axis, and the
+flatten/all_reduce dance is the automatic cotangent psum.
+
+Two workloads:
+
+- ``--workload llama``  — the reference's capability: the 288-d LLaMA on
+  TinyStories, 2 pipelines x 3 stages (collapses gracefully to the devices
+  available);
+- ``--workload resnet`` (default) — the BASELINE.json benchmark config:
+  ResNet-18/CIFAR-10 DP(+PP) with microbatches, printing samples/sec/chip
+  against the >= 5k north star.  With ``--pp`` the heterogeneous 2-stage
+  pipeline is used; default is pure DP (the fastest layout when the model
+  fits on one chip — pipelining a chip-resident ResNet only adds bubble).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workload", choices=("resnet", "llama"), default="resnet")
+    ap.add_argument("--iters", type=int, default=0,
+                    help="0 = workload default (resnet 30, llama 200)")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="global batch; 0 = workload default "
+                         "(resnet 1024/chip, llama 6)")
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="0 = workload default (resnet 2 when --pp, llama 3)")
+    ap.add_argument("--pp", action="store_true",
+                    help="resnet: use the 2-stage heterogeneous pipeline")
+    ap.add_argument("--lr", type=float, default=0.0,
+                    help="0 = workload default")
+    ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N")
+    return ap.parse_args(argv)
+
+
+def run_llama(args, jax, jnp):
+    import optax
+
+    from ddl25spring_tpu.data.tinystories import TinyStories
+    from ddl25spring_tpu.data.tokenizer import get_tokenizer
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel.pipeline import (
+        make_pipeline_train_step,
+        shard_staged_params,
+    )
+    from ddl25spring_tpu.utils.config import LlamaConfig
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    # reference topology 2x3 when possible, else collapse (SURVEY §3.1)
+    if n >= 6:
+        dp, S = 2, 3
+    elif n >= 4:
+        dp, S = 2, 2
+    elif n >= 2:
+        dp, S = 1, 2
+    else:
+        dp, S = 1, 1
+    mesh = make_mesh(devices[: dp * S], data=dp, stage=S)
+
+    tokenizer = get_tokenizer()
+    cfg = LlamaConfig(
+        vocab_size=tokenizer.vocab_size, dmodel=288, num_heads=6,
+        n_layers=6, ctx_size=256,
+        dtype="bfloat16" if devices[0].platform == "tpu" else "float32",
+    )
+    M = args.microbatches or 3
+    batch = args.batch or 3 * dp  # reference: batch 3 per pipeline
+    iters = args.iters or 200
+    print(f"llama DPxPP: mesh(data={dp}, stage={S}), batch={batch}, "
+          f"microbatches={M}")
+
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
+    tx = optax.adam(args.lr or 8e-4)
+    opt_state = tx.init(staged)
+    step = make_pipeline_train_step(
+        cfg, tx, mesh, M, data_axis="data" if dp > 1 else None
+    )
+
+    # disjoint per-replica data like the reference's skip=rank*N: one global
+    # stream here, sharded over the data axis by the step's in_spec
+    ds = iter(TinyStories(tokenizer, batch_size=batch, seq_l=cfg.ctx_size))
+    t0 = time.perf_counter()
+    for it in range(iters):
+        staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
+        if it % args.log_every == 0 or it == iters - 1:
+            print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
+    dt = time.perf_counter() - t0
+    tok_s = iters * batch * cfg.ctx_size / dt
+    print(f"done: {iters} iters in {dt:.1f}s ({tok_s:,.0f} tok/s, "
+          f"{tok_s / (dp * S):,.0f} tok/s/chip)")
+
+
+def run_resnet(args, jax, jnp):
+    import optax
+
+    from ddl25spring_tpu.data.cifar10 import load_cifar10
+    from ddl25spring_tpu.models.resnet import (
+        ResNet18, ResNet18Stage0, ResNet18Stage1,
+    )
+    from ddl25spring_tpu.ops.losses import cross_entropy_logits
+    from ddl25spring_tpu.parallel.dp import make_dp_train_step
+    from ddl25spring_tpu.parallel.het_pipeline import (
+        make_het_pipeline_train_step,
+    )
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    iters = args.iters or 30
+    warmup = 3
+
+    if args.pp and n >= 2:
+        dp, S = n // 2, 2
+    else:
+        dp, S = n, 1
+    n_used = dp * S  # odd counts strand a device in the --pp layout
+    batch = args.batch or 1024 * n_used
+    data = load_cifar10(n_train=batch, n_test=8)
+    batch = (min(batch, len(data["x_train"])) // (dp * (args.microbatches or 2))) \
+        * dp * (args.microbatches or 2)
+    x = jnp.asarray(data["x_train"][:batch])
+    y = jnp.asarray(data["y_train"][:batch])
+    tx = optax.sgd(args.lr or 0.1, momentum=0.9)
+
+    if S == 2:
+        M = args.microbatches or 2
+        mesh = make_mesh(devices, data=dp, stage=S) if dp > 1 else \
+            make_mesh(devices[:2], stage=2)
+        s0, s1 = ResNet18Stage0(dtype=dtype), ResNet18Stage1(dtype=dtype)
+        p0 = s0.init(jax.random.PRNGKey(0), x[:8])["params"]
+        mid = s0.apply({"params": p0}, x[:8])
+        p1 = s1.init(jax.random.PRNGKey(1), mid)["params"]
+        params = (p0, p1)
+        mb = batch // M // dp
+        step_pp = make_het_pipeline_train_step(
+            [lambda p, h: s0.apply({"params": p}, h),
+             lambda p, h: s1.apply({"params": p}, h)],
+            lambda logits, b: cross_entropy_logits(logits, b["y"]),
+            (mb, 32, 32, 3), [(mb, 16, 16, 128), (mb, 10)],
+            tx, mesh, M, data_axis="data" if dp > 1 else None,
+            compute_dtype=dtype,
+        )
+        opt_state = tx.init(params)
+        topo = f"mesh(data={dp}, stage=2), microbatches={M}"
+
+        def step(params, opt_state, bat, key):
+            return step_pp(params, opt_state, bat)
+
+        batch_pytree = {"x": x, "y": y}
+    else:
+        mesh = make_mesh(devices, data=dp)
+        model = ResNet18(norm="group", dtype=dtype)
+        params = model.init(jax.random.PRNGKey(0), x[:8])["params"]
+
+        def loss_fn(p, bat, key):
+            xb, yb = bat
+            logits = model.apply({"params": p}, xb.astype(dtype), train=True)
+            return cross_entropy_logits(logits, yb)
+
+        step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+        opt_state = tx.init(params)
+        topo = f"mesh(data={dp})"
+        batch_pytree = (x, y)
+
+    print(f"resnet18/cifar10: {topo}, global batch={batch}, "
+          f"{n_used}/{n} device(s) in mesh")
+    key = jax.random.PRNGKey(2)
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, batch_pytree, key)
+    float(loss)  # force completion (async dispatch)
+
+    t0 = time.perf_counter()
+    for it in range(iters):
+        params, opt_state, loss = step(params, opt_state, batch_pytree, key)
+        if args.log_every and (it % args.log_every == 0):
+            print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
+    float(loss)
+    dt = time.perf_counter() - t0
+    sps_chip = iters * batch / dt / n_used
+    print(json.dumps({
+        "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
+        "value": round(sps_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / 5000.0, 3),
+    }))
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    if args.force_cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
+        ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    if args.workload == "llama":
+        run_llama(args, jax, jnp)
+    else:
+        run_resnet(args, jax, jnp)
+
+
+if __name__ == "__main__":
+    main()
