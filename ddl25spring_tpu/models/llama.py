@@ -78,10 +78,18 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
     return ((x32 / rms) * scale).astype(x.dtype)
 
 
-def rope_angles(seq_len: int, head_dim: int, base: float = 10_000.0):
-    pos = jnp.arange(seq_len, dtype=jnp.float32)
+def rope_angles(
+    seq_len: int,
+    head_dim: int,
+    base: float = 10_000.0,
+    pos: jax.Array | None = None,
+):
+    """``pos`` overrides ``arange(seq_len)`` — sequence-parallel shards pass
+    their GLOBAL positions so rotary phases match the unsharded model."""
+    if pos is None:
+        pos = jnp.arange(seq_len, dtype=jnp.float32)
     inv = base ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
-    ang = pos[:, None] * inv[None, :]  # [L, hd/2]
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]  # [L, hd/2]
     return jnp.cos(ang), jnp.sin(ang)
 
 
@@ -94,42 +102,77 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.reshape(x.shape).astype(x.dtype)
 
 
-def block_forward(p: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def causal_attention(q, k, v, dtype):
+    """Dense causal attention (fp32 softmax): the single-device / TP path."""
+    hd = q.shape[-1]
+    L, Lk = q.shape[1], k.shape[1]
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    mask = jnp.tril(jnp.ones((L, Lk), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def block_forward(
+    p: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    tp_axis: str | None = None,
+    pos: jax.Array | None = None,
+    attn_fn=None,
+) -> jax.Array:
     """One pre-norm transformer block: RMSNorm -> causal RoPE attention ->
-    residual -> RMSNorm -> SwiGLU -> residual."""
+    residual -> RMSNorm -> SwiGLU -> residual.
+
+    Parallel hooks (both off by default = the serial block):
+
+    - ``tp_axis``: Megatron-style tensor parallelism inside ``shard_map`` —
+      ``p`` holds this device's column slice of wq/wk/wv/w_gate/w_up and row
+      slice of wo/w_down; the two row-sharded matmuls are followed by a
+      ``psum`` over the axis.  Local head count is derived from the param
+      slice, so the same code runs sharded and unsharded.
+    - ``pos`` / ``attn_fn``: sequence parallelism — global RoPE positions for
+      this shard's tokens and a ring-attention implementation.
+    """
     dtype = jnp.dtype(cfg.dtype)
     B, L, D = x.shape
-    H, hd = cfg.num_heads, cfg.head_dim
+    hd = cfg.head_dim
 
     h = rms_norm(x, p["ln1"])
-    q = (h @ p["wq"].astype(dtype)).reshape(B, L, H, hd)
-    k = (h @ p["wk"].astype(dtype)).reshape(B, L, H, hd)
-    v = (h @ p["wv"].astype(dtype)).reshape(B, L, H, hd)
-    cos, sin = rope_angles(L, hd)
+    q = (h @ p["wq"].astype(dtype)).reshape(B, L, -1, hd)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, L, -1, hd)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, L, -1, hd)
+    cos, sin = rope_angles(L, hd, pos=pos)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    scores = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(jnp.float32(hd))
-    mask = jnp.tril(jnp.ones((L, L), bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    attn = jnp.einsum("bhlm,bmhd->blhd", probs, v).reshape(B, L, D)
-    x = x + attn @ p["wo"].astype(dtype)
+    attn = (attn_fn or causal_attention)(q, k, v, dtype)
+    attn = attn.reshape(B, L, -1)
+    attn_out = attn @ p["wo"].astype(dtype)
+    if tp_axis is not None:
+        attn_out = lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = rms_norm(x, p["ln2"])
     gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
     up = h @ p["w_up"].astype(dtype)
-    x = x + (gate * up) @ p["w_down"].astype(dtype)
+    ffn_out = (gate * up) @ p["w_down"].astype(dtype)
+    if tp_axis is not None:
+        ffn_out = lax.psum(ffn_out, tp_axis)
+    x = x + ffn_out
     return x
 
 
-def apply_blocks(stacked: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def apply_blocks(
+    stacked: Params, x: jax.Array, cfg: LlamaConfig, **block_kw
+) -> jax.Array:
     """Apply a stack of blocks (leading layer axis) via ``lax.scan`` — the
     compiler-friendly loop (one block body compiled once)."""
 
     def body(h, block_p):
-        return block_forward(block_p, h, cfg), None
+        return block_forward(block_p, h, cfg, **block_kw), None
 
     out, _ = lax.scan(body, x, stacked)
     return out

@@ -1,0 +1,166 @@
+"""Sequence/context parallelism: ring attention over a ``seq`` mesh axis.
+
+The reference caps context at 256 tokens with unsharded attention (SURVEY §5:
+long-context absent) — this module is the TPU-native long-context extension.
+Tokens shard over a ``seq`` axis: each device holds ``L/n`` positions of
+every sequence, activations never materialize full length, and attention runs
+as a RING — each of ``n`` steps combines the local queries with one rotating
+KV block (online-softmax accumulation in fp32), then ``ppermute``s the KV
+block to the next neighbor over ICI.  Compute overlaps transfer by structure:
+the permute is inside the same scanned step XLA schedules around the matmuls.
+
+Causality is handled by GLOBAL positions: query at global position i attends
+key at global position j iff j <= i, so rotated blocks are masked per
+(q_pos, kv_pos) pair — no schedule-order assumptions.
+
+The causal-LM loss needs one extra hop: the target of a shard's LAST token is
+the NEXT shard's first token, fetched with a single ``ppermute`` of one token
+per sequence (the only cross-shard data the loss requires).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Params = dict[str, Any]
+
+
+def ring_attention(q, k, v, axis: str, q_pos, kv_pos, dtype):
+    """Causal ring attention inside ``shard_map``.
+
+    ``q/k/v``: ``[B, Ll, H, hd]`` local shards; ``q_pos/kv_pos``: ``[Ll]``
+    global positions of the local queries / of the CURRENT kv block (rotates
+    with it).  Returns ``[B, Ll, H, hd]``.
+    """
+    n = lax.psum(1, axis)
+    hd = q.shape[-1]
+    B, Ll, H, _ = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, _):
+        k_blk, v_blk, pos_blk, m, l, o = carry
+        s = jnp.einsum("blhd,bmhd->bhlm", q32, k_blk.astype(jnp.float32))
+        s = s * scale
+        causal = q_pos[:, None] >= pos_blk[None, :]  # [Ll, Lkv]
+        s = jnp.where(causal[None, None], s, -jnp.inf)
+
+        m_blk = s.max(-1)                      # [B, H, Ll]
+        m_new = jnp.maximum(m, m_blk)
+        # exp(-inf - -inf) guards: where a row has seen nothing yet, m_new
+        # may still be -inf; make the correction factor 0, not nan
+        corr = jnp.where(m == -jnp.inf, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(jnp.where(s == -jnp.inf, -jnp.inf, s - m_new[..., None]))
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhlm,bmhd->bhld", p, v_blk.astype(jnp.float32)
+        )
+
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        pos_blk = lax.ppermute(pos_blk, axis, perm)
+        return (k_blk, v_blk, pos_blk, m_new, l_new, o_new), None
+
+    # derive the accumulator inits from q (0*q keeps values exact) so they
+    # carry q's varying-axes type — a plain jnp.zeros is axis-invariant and
+    # shard_map's scan typing rejects the carry mismatch
+    zero_blh = 0.0 * q32[..., 0].transpose(0, 2, 1)        # [B, H, Ll]
+    init = (
+        k, v, kv_pos,
+        zero_blh - jnp.inf,
+        zero_blh,
+        0.0 * q32.transpose(0, 2, 1, 3),                   # [B, H, Ll, hd]
+    )
+    (_, _, _, _, l, o), _ = lax.scan(step, init, None, length=n)
+    # every causal row has at least its own diagonal -> l > 0
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # [B, Ll, H, hd]
+    return out.astype(dtype)
+
+
+def make_sp_loss(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    data_axis: str | None = None,
+):
+    """``loss(params, tokens) -> scalar``: full llama forward with tokens
+    sharded ``[B, L/n]`` over ``seq_axis`` and ring attention in every block.
+    Matches :func:`~ddl25spring_tpu.models.llama.llama_forward` + causal-LM
+    loss on the unsharded model."""
+    n = mesh.shape[seq_axis]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis)),
+        out_specs=P(),
+    )
+    def sp_loss(params: Params, tokens: jax.Array) -> jax.Array:
+        axes = (seq_axis,) + ((data_axis,) if data_axis else ())
+        vparams = lax.pcast(params, axes, to="varying")
+        B, Ll = tokens.shape
+        offset = lax.axis_index(seq_axis) * Ll
+        pos = offset + jnp.arange(Ll)
+
+        attn = partial(ring_attention, axis=seq_axis, q_pos=pos, kv_pos=pos)
+        x = llama.embed(vparams, tokens, cfg)
+        x = llama.apply_blocks(
+            vparams["blocks"], x, cfg,
+            pos=pos,
+            attn_fn=lambda q, k, v, dtype: attn(q, k, v, dtype=dtype),
+        )
+        logits = llama.unembed(vparams, x, cfg)  # [B, Ll, V] fp32
+
+        # boundary target: next shard's first token (one-token ppermute)
+        nxt = lax.ppermute(
+            tokens[:, :1], seq_axis, [((i + 1) % n, i) for i in range(n)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        # the final shard's last position has no target (wrapped token):
+        # mask it, matching the serial loss over L-1 positions
+        is_last_shard = lax.axis_index(seq_axis) == n - 1
+        valid = jnp.where(
+            is_last_shard & (jnp.arange(Ll) == Ll - 1), 0.0, 1.0
+        )[None, :]
+        local_sum = -(picked * valid).sum()
+        local_cnt = (valid * jnp.ones((B, 1))).sum()
+        total = lax.psum(local_sum, seq_axis) / lax.psum(local_cnt, seq_axis)
+        if data_axis is not None:
+            total = lax.pmean(total, data_axis)
+        return total
+
+    return sp_loss
+
+
+def make_sp_train_step(
+    cfg: LlamaConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    data_axis: str | None = None,
+):
+    """Jitted SP(xDP) train step (params replicated, tokens seq-sharded)."""
+    loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -5,7 +5,7 @@
 # pipeline stages are mesh devices inside one jitted SPMD program, so there
 # is no per-rank spawn loop, no out<rank>.txt fan-out, and no rendezvous.
 
-cd "$(dirname "$0")" || return
+cd "$(dirname "$0")" || exit 1
 START_TIME=$SECONDS
 
 python -u s01_b1_microbatches.py "$@"

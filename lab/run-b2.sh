@@ -8,7 +8,7 @@
 # samples/sec/chip vs the >=5k north star); pass "--workload llama" for the
 # reference's original LLaMA-on-TinyStories DPxPP run.
 
-cd "$(dirname "$0")" || return
+cd "$(dirname "$0")" || exit 1
 START_TIME=$SECONDS
 
 python -u s01_b2_dp_pp.py "$@"

@@ -98,6 +98,9 @@ def run_llama(args, jax, jnp):
     # disjoint per-replica data like the reference's skip=rank*N: one global
     # stream here, sharded over the data axis by the step's in_spec
     ds = iter(TinyStories(tokenizer, batch_size=batch, seq_l=cfg.ctx_size))
+    # warmup outside the timer: jit compile dominates the first step
+    staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
+    float(loss)
     t0 = time.perf_counter()
     for it in range(iters):
         staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
@@ -135,7 +138,11 @@ def run_resnet(args, jax, jnp):
     else:
         dp, S = n, 1
     n_used = dp * S  # odd counts strand a device in the --pp layout
-    batch = args.batch or 1024 * n_used
+    # CPU simulation can't sustain the TPU-sized default batch: a --pp tick
+    # slower than XLA's ~40s collective-rendezvous deadline aborts the
+    # process, and full-width conv ticks on fake CPU devices hit that at
+    # microbatches of ~16; default to microbatches of ~4
+    batch = args.batch or (1024 if on_tpu else 4) * n_used
     data = load_cifar10(n_train=batch, n_test=8)
     batch = (min(batch, len(data["x_train"])) // (dp * (args.microbatches or 2))) \
         * dp * (args.microbatches or 2)
@@ -157,7 +164,7 @@ def run_resnet(args, jax, jnp):
             [lambda p, h: s0.apply({"params": p}, h),
              lambda p, h: s1.apply({"params": p}, h)],
             lambda logits, b: cross_entropy_logits(logits, b["y"]),
-            (mb, 32, 32, 3), [(mb, 16, 16, 128), (mb, 10)],
+            (mb, 32, 32, 3), [(mb,) + mid.shape[1:], (mb, 10)],
             tx, mesh, M, data_axis="data" if dp > 1 else None,
             compute_dtype=dtype,
         )
