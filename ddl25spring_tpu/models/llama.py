@@ -148,7 +148,14 @@ def block_forward(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    attn = (attn_fn or causal_attention)(q, k, v, dtype)
+    if attn_fn is None:
+        if cfg.use_flash:
+            from ddl25spring_tpu.ops.flash_attention import flash_attention
+
+            attn_fn = lambda q, k, v, dtype: flash_attention(q, k, v)
+        else:
+            attn_fn = causal_attention
+    attn = attn_fn(q, k, v, dtype)
     attn = attn.reshape(B, L, -1)
     attn_out = attn @ p["wo"].astype(dtype)
     if tp_axis is not None:

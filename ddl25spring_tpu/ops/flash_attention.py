@@ -1,0 +1,329 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+The hot op of the LLaMA workload.  The XLA path in
+:func:`ddl25spring_tpu.models.llama.causal_attention` materializes the
+``[B, H, L, L]`` score tensor in HBM; this kernel never does — each grid
+program streams K/V blocks through VMEM, keeping an online-softmax running
+max/sum (the flash-attention recurrence) so attention memory is O(L·d)
+instead of O(L²).  That is the difference between HBM-bandwidth-bound and
+MXU-bound attention on TPU, and it is what makes ctx >> the reference's 256
+(``lab/s01_b1_microbatches.py:24``) trainable at all.
+
+Layout: inputs ``[B, L, H, hd]`` are folded to ``[B*H, L, hd]``; the grid is
+``(B*H, L/block_q)`` for the forward and dq passes and ``(B*H, L/block_k)``
+for the dk/dv pass.  Causality skips whole KV blocks above the diagonal
+(``fori_loop`` upper bound), so the forward does ~half the block matmuls.
+The backward is the standard two-kernel flash recomputation from the saved
+``(o, lse)`` residuals — no score tensor in either direction.
+
+All matmuls accumulate in fp32 (``preferred_element_type``); bf16 in/out.
+``interpret=True`` runs the same kernels on CPU — used by the equivalence
+tests against the dense reference implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _pos(base: int, n: int):
+    # TPU needs >= 2-D iota; broadcasted_iota then squeeze
+    return base + jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]
+
+
+# ------------------------------------------------------------------ forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
+    bq = q_ref.shape[1]
+    hd = q_ref.shape[2]
+    L = k_ref.shape[1]
+    qi = pl.program_id(1)
+    # operands stay in input dtype (bf16 on TPU -> MXU-native matmuls);
+    # preferred_element_type gives fp32 accumulation, softmax math is fp32
+    q = q_ref[0]                                       # [bq, hd]
+    q_pos = _pos(qi * bq, bq)
+
+    nk_all = L // block_k
+    # causal: KV blocks strictly above the diagonal contribute nothing
+    nk = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk_all) \
+        if causal else nk_all
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, bk] fp32
+        if causal:
+            kv_pos = _pos(j * block_k, block_k)
+            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, NEG_INF)
+        m_blk = s.max(-1)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])                # NEG_INF -> ~0
+        l_new = l * corr + p.sum(-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # lse is [BH, L, 1]: a (1, bq, 1) block satisfies the TPU tiling rule
+    # (trailing dim equals the array dim) where a (1, bq) block cannot
+    lse_ref[0, :, 0] = m + jnp.log(l)
+
+
+def _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret):
+    BH, L, hd = q3.shape
+    nq = L // block_q
+    grid = (BH, nq)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_k=block_k, scale=scale, causal=causal
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+            jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ----------------------------------------------------------------- backward
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k, scale, causal,
+):
+    bq = q_ref.shape[1]
+    L = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    q_pos = _pos(qi * bq, bq)
+
+    nk_all = L // block_k
+    nk = jnp.minimum(((qi + 1) * bq + block_k - 1) // block_k, nk_all) \
+        if causal else nk_all
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            kv_pos = _pos(j * block_k, block_k)
+            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, nk, body, jnp.zeros((bq, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, scale, causal,
+):
+    bk = k_ref.shape[1]
+    L = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    kv_pos = _pos(ki * bk, bk)
+
+    nq_all = L // block_q
+    # causal: q blocks strictly below this kv block see none of it
+    start = (ki * bk) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, bk] fp32
+        if causal:
+            q_pos = _pos(i * block_q, block_q)
+            s = jnp.where(q_pos[:, None] >= kv_pos[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        p_lo = p.astype(do_blk.dtype)
+        dv = dv + jax.lax.dot_general(
+            p_lo, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    hd = k.shape[1]
+    dk, dv = jax.lax.fori_loop(
+        start, nq_all, body,
+        (jnp.zeros((bk, hd), jnp.float32), jnp.zeros((bk, hd), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _choose_block(L: int, want: int) -> int:
+    """Largest block <= ``want`` that divides ``L`` and satisfies the TPU
+    sublane rule (multiple of 8), falling back to the whole axis (a block
+    equal to the array dim is always legal) — so any ctx_size works."""
+    b = min(want, L)
+    if L % b == 0 and (b % 8 == 0 or b == L):
+        return b
+    for c in range(b - b % 8, 7, -8):
+        if L % c == 0:
+            return c
+    return L
+
+
+# -------------------------------------------------------------- public API
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash(q3, k3, v3, block_q, block_k, causal, interpret):
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, _ = _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, block_q, block_k, causal, interpret):
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, lse = _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(block_q, block_k, causal, interpret, res, do):
+    q3, k3, v3, o, lse = res
+    BH, L, hd = q3.shape
+    scale = 1.0 / (hd ** 0.5)
+    # [BH, L, 1] like lse (TPU block-tiling rule, see _fwd_kernel)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_k=block_k, scale=scale, causal=causal
+        ),
+        grid=(BH, L // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, L, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, L, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, scale=scale, causal=causal
+        ),
+        grid=(BH, L // block_k),
+        in_specs=[
+            pl.BlockSpec((1, L, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, L, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, L, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal flash attention.  ``q/k/v``: ``[B, L, H, hd]`` -> ``[B, L, H, hd]``.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same call
+    works in CPU tests and in TPU production.  ``L`` must divide by both
+    block sizes (the LLaMA ctx sizes here are powers of two).
+    """
+    B, L, H, hd = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq, bk = _choose_block(L, block_q), _choose_block(L, block_k)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, L, hd)
+
+    o3 = _flash(fold(q), fold(k), fold(v), bq, bk, causal, interpret)
+    return o3.reshape(B, H, L, hd).transpose(0, 2, 1, 3)

@@ -23,6 +23,7 @@ class LlamaConfig:
     ctx_size: int = 256
     pad_id: int = 0
     dtype: str = "bfloat16"     # MXU-friendly compute dtype; params stay fp32
+    use_flash: bool = False     # Pallas flash-attention kernel for the hot op
 
     @property
     def head_dim(self) -> int:
