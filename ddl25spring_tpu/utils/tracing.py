@@ -1,0 +1,74 @@
+"""Tracing / profiling hooks.
+
+The reference's only observability is manual ``perf_counter`` segments in the
+FL servers (``hfl_complete.py:274-307``) and whole-run ``$SECONDS`` in the
+launchers (``run-b1.sh:6,16-17``) — kept here as
+:class:`ddl25spring_tpu.utils.metrics.Timer`.  This module adds the TPU-side
+instruments those hooks cannot see:
+
+- :func:`trace` — a ``jax.profiler`` trace context producing a TensorBoard/
+  Perfetto-loadable profile of XLA execution (MXU utilization, HBM traffic,
+  collective time — the real versions of the reference's wall-clock guesses);
+- :func:`annotate` — named host-side regions that show up inside the trace;
+- :class:`StepTimer` — steady-state steps/sec with correct async-dispatch
+  handling (blocks on the result, discards warmup/compile).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax.profiler trace of everything inside the block."""
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region (context manager) visible in profiler traces."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Throughput meter for jitted train loops.
+
+    ``tick(result)`` blocks until ``result`` is ready (so async dispatch
+    doesn't fold the next step's work into this step's time) and records the
+    interval.  The first ``warmup`` intervals (compile) are discarded.
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times: list[float] = []
+        self._last: float | None = None
+        self._seen = 0
+
+    def tick(self, result: Any = None) -> None:
+        if result is not None:
+            jax.block_until_ready(result)
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                self.times.append(now - self._last)
+        self._last = now
+
+    @property
+    def mean_step_s(self) -> float:
+        if not self.times:
+            raise ValueError("no timed steps yet (all in warmup?)")
+        return sum(self.times) / len(self.times)
+
+    def steps_per_sec(self) -> float:
+        return 1.0 / self.mean_step_s

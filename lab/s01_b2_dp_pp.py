@@ -46,6 +46,10 @@ def parse_args(argv=None):
                     help="0 = workload default")
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N")
+    ap.add_argument("--ckpt-dir", default="",
+                    help="llama workload: checkpoint/resume directory; a "
+                         "relaunched run continues from the latest step")
+    ap.add_argument("--ckpt-every", type=int, default=100)
     return ap.parse_args(argv)
 
 
@@ -95,18 +99,50 @@ def run_llama(args, jax, jnp):
         cfg, tx, mesh, M, data_axis="data" if dp > 1 else None
     )
 
+    start_it = 0
+    ckpt = None
+    if args.ckpt_dir:
+        from ddl25spring_tpu.utils.checkpoint import (
+            Checkpointer, with_mesh_placement,
+        )
+
+        ckpt = Checkpointer(args.ckpt_dir)
+        state, start_it = ckpt.restore_or_init(
+            with_mesh_placement({"params": staged, "opt_state": opt_state}, mesh)
+        )
+        staged, opt_state = state["params"], state["opt_state"]
+        if start_it:
+            print(f"resumed from step {start_it - 1} in {args.ckpt_dir}")
+
     # disjoint per-replica data like the reference's skip=rank*N: one global
     # stream here, sharded over the data axis by the step's in_spec
-    ds = iter(TinyStories(tokenizer, batch_size=batch, seq_l=cfg.ctx_size))
-    # warmup outside the timer: jit compile dominates the first step
-    staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
-    float(loss)
+    ds = iter(TinyStories(
+        tokenizer, batch_size=batch, seq_l=cfg.ctx_size,
+        skip=start_it * batch,
+    ))
+    # warmup outside the timer: jit compile dominates the first step.  The
+    # outputs are DISCARDED — a warmup that stepped the optimizer would give
+    # every resumed run one extra update and break kill-and-resume
+    # equivalence with an uninterrupted run
+    _ = step(staged, opt_state, jnp.asarray(next(ds)))
+    float(_[2])
     t0 = time.perf_counter()
-    for it in range(iters):
+    last_it = start_it - 1
+    for it in range(start_it, start_it + iters):
         staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
-        if it % args.log_every == 0 or it == iters - 1:
+        if it % args.log_every == 0 or it == start_it + iters - 1:
             print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
+        if ckpt is not None and args.ckpt_every > 0 \
+                and (it + 1) % args.ckpt_every == 0:
+            ckpt.save(it, {"params": staged, "opt_state": opt_state})
+        last_it = it
     dt = time.perf_counter() - t0
+    if ckpt is not None and last_it >= start_it:
+        # persist the tail: without this, up to ckpt_every-1 trailing steps
+        # would be redone on relaunch
+        ckpt.save(last_it, {"params": staged, "opt_state": opt_state},
+                  force=True)
+        ckpt.close()
     tok_s = iters * batch * cfg.ctx_size / dt
     print(f"done: {iters} iters in {dt:.1f}s ({tok_s:,.0f} tok/s, "
           f"{tok_s / (dp * S):,.0f} tok/s/chip)")
