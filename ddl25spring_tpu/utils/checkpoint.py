@@ -73,6 +73,11 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def steps(self) -> list[int]:
+        """Steps currently on disk (oldest pruned per ``max_to_keep``)."""
+        self._mgr.wait_until_finished()
+        return sorted(self._mgr.all_steps())
+
     def restore(self, step: int | None = None, template: State | None = None):
         """Restore ``step`` (default latest).  ``template`` — a pytree of
         arrays or ShapeDtypeStruct(sharding=...) — pins restored dtypes,

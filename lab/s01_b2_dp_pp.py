@@ -50,6 +50,11 @@ def parse_args(argv=None):
                     help="llama workload: checkpoint/resume directory; a "
                          "relaunched run continues from the latest step")
     ap.add_argument("--ckpt-every", type=int, default=100)
+    ap.add_argument("--stream", action="store_true",
+                    help="resnet: stream a fresh batch per step through the "
+                         "native C++ prefetching loader (needs real CIFAR-10 "
+                         "binaries via DDL25_CIFAR10_DIR) instead of reusing "
+                         "one device-resident batch")
     return ap.parse_args(argv)
 
 
@@ -130,7 +135,8 @@ def run_llama(args, jax, jnp):
     last_it = start_it - 1
     for it in range(start_it, start_it + iters):
         staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
-        if it % args.log_every == 0 or it == start_it + iters - 1:
+        if (args.log_every and it % args.log_every == 0) \
+                or it == start_it + iters - 1:
             print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
         if ckpt is not None and args.ckpt_every > 0 \
                 and (it + 1) % args.ckpt_every == 0:
@@ -139,9 +145,11 @@ def run_llama(args, jax, jnp):
     dt = time.perf_counter() - t0
     if ckpt is not None and last_it >= start_it:
         # persist the tail: without this, up to ckpt_every-1 trailing steps
-        # would be redone on relaunch
-        ckpt.save(last_it, {"params": staged, "opt_state": opt_state},
-                  force=True)
+        # would be redone on relaunch.  Skip if the loop's periodic save
+        # already covered last_it (orbax refuses duplicate steps).
+        if args.ckpt_every <= 0 or (last_it + 1) % args.ckpt_every != 0:
+            ckpt.save(last_it, {"params": staged, "opt_state": opt_state},
+                      force=True)
         ckpt.close()
     tok_s = iters * batch * cfg.ctx_size / dt
     print(f"done: {iters} iters in {dt:.1f}s ({tok_s:,.0f} tok/s, "
@@ -182,8 +190,12 @@ def run_resnet(args, jax, jnp):
     data = load_cifar10(n_train=batch, n_test=8)
     batch = (min(batch, len(data["x_train"])) // (dp * (args.microbatches or 2))) \
         * dp * (args.microbatches or 2)
-    x = jnp.asarray(data["x_train"][:batch])
-    y = jnp.asarray(data["y_train"][:batch])
+    x_host = data["x_train"][:batch]
+    y_host = data["y_train"][:batch]
+    # init below only touches x[:8]; the full fixed batch goes to the device
+    # only when it IS the feed (no --stream), so streaming runs don't pin
+    # ~12 MB/1024-batch of dead fp32 in HBM
+    x = jnp.asarray(x_host[:8])
     tx = optax.sgd(args.lr or 0.1, momentum=0.9)
 
     if S == 2:
@@ -210,7 +222,8 @@ def run_resnet(args, jax, jnp):
         def step(params, opt_state, bat, key):
             return step_pp(params, opt_state, bat)
 
-        batch_pytree = {"x": x, "y": y}
+        def fixed_batch():
+            return {"x": jnp.asarray(x_host), "y": jnp.asarray(y_host)}
     else:
         mesh = make_mesh(devices, data=dp)
         model = ResNet18(norm="group", dtype=dtype)
@@ -224,18 +237,48 @@ def run_resnet(args, jax, jnp):
         step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
         opt_state = tx.init(params)
         topo = f"mesh(data={dp})"
-        batch_pytree = (x, y)
+
+        def fixed_batch():
+            return (jnp.asarray(x_host), jnp.asarray(y_host))
+
+    stream = None
+    if args.stream:
+        from ddl25spring_tpu.data.native_loader import (
+            NativeCifar10Loader, NativeLoaderUnavailable, normalize_on_device,
+        )
+
+        cdir = os.environ.get("DDL25_CIFAR10_DIR", "data/cifar-10-batches-bin")
+        try:
+            # raw uint8 over the host->device link (4x less traffic than
+            # fp32); normalization happens device-side
+            stream = iter(
+                NativeCifar10Loader(cdir, batch_size=batch, normalize=False)
+            )
+        except NativeLoaderUnavailable as e:
+            print(f"native loader unavailable ({e}); using fixed batch")
+
+    batch_pytree = fixed_batch() if stream is None else None
+
+    def feed():
+        if stream is None:
+            return batch_pytree
+        xs, ys = next(stream)
+        xd = normalize_on_device(jnp.asarray(xs))
+        if S == 2:
+            return {"x": xd, "y": jnp.asarray(ys)}
+        return (xd, jnp.asarray(ys))
 
     print(f"resnet18/cifar10: {topo}, global batch={batch}, "
-          f"{n_used}/{n} device(s) in mesh")
+          f"{n_used}/{n} device(s) in mesh"
+          + (", native streaming input" if stream is not None else ""))
     key = jax.random.PRNGKey(2)
     for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, batch_pytree, key)
+        params, opt_state, loss = step(params, opt_state, feed(), key)
     float(loss)  # force completion (async dispatch)
 
     t0 = time.perf_counter()
     for it in range(iters):
-        params, opt_state, loss = step(params, opt_state, batch_pytree, key)
+        params, opt_state, loss = step(params, opt_state, feed(), key)
         if args.log_every and (it % args.log_every == 0):
             print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
     float(loss)

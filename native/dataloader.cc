@@ -1,0 +1,257 @@
+// Native host-side data loader / prefetcher for CIFAR-10 binary batches.
+//
+// Role: the framework's C++ runtime component for input pipelines.  The
+// reference leans on native data machinery in its third-party deps
+// (torchvision's C image decoders, SentencePiece's C++ tokenizer — SURVEY
+// §2 "native components"); here the equivalent is in-tree: parsing,
+// per-epoch shuffling, normalization, and batch assembly run in C++ worker
+// threads that stay ahead of the TPU step loop, so host input work overlaps
+// device compute instead of serializing with it.
+//
+// Pipeline: N worker threads pull batch indices from a ticket counter, each
+// assembles one normalized float32 NHWC batch straight from the mmap-like
+// in-memory byte store, and pushes it into a bounded queue (depth =
+// prefetch_depth) consumed by dl_next().  Shuffling is a seeded
+// Fisher-Yates permutation re-derived per epoch from (seed, epoch) so runs
+// are deterministic; batches are emitted in epoch order regardless of which
+// worker finishes first (per-slot reordering).
+//
+// C ABI (ctypes-consumed; see ddl25spring_tpu/data/native_loader.py):
+//   dl_create(dir, batch, seed, depth, workers) -> handle (0 on error)
+//   dl_num_samples(h), dl_batch_bytes_x(h), dl_error(h)
+//   dl_next(h, float* x, int32* y) -> epoch of the batch (>=0), blocking
+//   dl_destroy(h)
+//
+// CIFAR-10 record format: 1 label byte + 3072 channel-major pixel bytes
+// (3x32x32 RGB); output is NHWC float32 normalized with the canonical
+// train statistics — byte-identical semantics to the numpy path in
+// ddl25spring_tpu/data/cifar10.py.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <filesystem>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace fs = std::filesystem;
+
+namespace {
+
+constexpr int kH = 32, kW = 32, kC = 3;
+constexpr int kRecordBytes = 1 + kH * kW * kC;
+constexpr float kMean[kC] = {0.4914f, 0.4822f, 0.4465f};
+constexpr float kStd[kC] = {0.2470f, 0.2435f, 0.2616f};
+
+struct Batch {
+  long index = 0;  // global batch counter (epoch * batches_per_epoch + i)
+  std::vector<float> x;      // normalized mode
+  std::vector<uint8_t> xb;   // raw mode (uint8 NHWC; device normalizes)
+  std::vector<int32_t> y;
+};
+
+class Loader {
+ public:
+  Loader(const char* dir, int batch, uint64_t seed, int depth, int workers,
+         bool normalize)
+      : batch_(batch), seed_(seed), depth_(depth < 1 ? 1 : depth),
+        normalize_(normalize) {
+    for (int i = 1; i <= 6; ++i) {
+      fs::path p = fs::path(dir) / ("data_batch_" + std::to_string(i) + ".bin");
+      if (fs::exists(p)) Append(p);
+    }
+    if (records_ == 0) {
+      fs::path p = fs::path(dir) / "train.bin";  // single-file layout
+      if (fs::exists(p)) Append(p);
+    }
+    if (records_ < static_cast<size_t>(batch_)) {
+      error_ = "no usable data_batch_*.bin under " + std::string(dir);
+      return;
+    }
+    int n = workers < 1 ? 1 : workers;
+    for (int i = 0; i < n; ++i)
+      threads_.emplace_back([this] { Work(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_full_.notify_all();
+    cv_empty_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  const char* error() const { return error_.empty() ? nullptr : error_.c_str(); }
+  long num_samples() const { return static_cast<long>(records_); }
+  long batches_per_epoch() const { return static_cast<long>(records_) / batch_; }
+
+  // Blocking: copies the next in-order batch into caller buffers.
+  // out_x is float32 in normalized mode, uint8 in raw mode.
+  long Next(void* out_x, int32_t* out_y) {
+    std::unique_lock<std::mutex> lk(mu_);
+    long want = next_out_;
+    cv_empty_.wait(lk, [&] { return stop_ || ready_.count(want); });
+    if (stop_ && !ready_.count(want)) return -1;
+    Batch b = std::move(ready_[want]);
+    ready_.erase(want);
+    ++next_out_;
+    lk.unlock();
+    cv_full_.notify_all();
+    if (normalize_)
+      std::memcpy(out_x, b.x.data(), b.x.size() * sizeof(float));
+    else
+      std::memcpy(out_x, b.xb.data(), b.xb.size());
+    std::memcpy(out_y, b.y.data(), b.y.size() * sizeof(int32_t));
+    return want / batches_per_epoch();  // epoch index
+  }
+
+ private:
+  void Append(const fs::path& p) {
+    std::ifstream f(p, std::ios::binary);
+    std::vector<char> buf((std::istreambuf_iterator<char>(f)),
+                          std::istreambuf_iterator<char>());
+    size_t n = buf.size() / kRecordBytes;
+    data_.insert(data_.end(), buf.begin(),
+                 buf.begin() + static_cast<long>(n * kRecordBytes));
+    records_ += n;
+  }
+
+  // Per-epoch deterministic permutation: mt19937_64(seed ^ epoch).
+  std::vector<uint32_t> Perm(long epoch) const {
+    std::vector<uint32_t> idx(records_);
+    std::iota(idx.begin(), idx.end(), 0u);
+    std::mt19937_64 rng(seed_ + 0x9e3779b97f4a7c15ULL * (epoch + 1));
+    for (size_t i = records_ - 1; i > 0; --i) {
+      std::uniform_int_distribution<size_t> d(0, i);
+      std::swap(idx[i], idx[d(rng)]);
+    }
+    return idx;
+  }
+
+  void Assemble(long global_idx, Batch* out) const {
+    long bpe = static_cast<long>(records_) / batch_;
+    long epoch = global_idx / bpe, slot = global_idx % bpe;
+    // Workers on the same epoch share the permutation via a small cache of
+    // shared_ptrs — copying the pointer, not the 4*records_ byte vector.
+    std::shared_ptr<const std::vector<uint32_t>> perm_p;
+    {
+      std::lock_guard<std::mutex> lk(perm_mu_);
+      auto it = perm_cache_.find(epoch);
+      if (it == perm_cache_.end()) {
+        it = perm_cache_
+                 .emplace(epoch, std::make_shared<const std::vector<uint32_t>>(
+                                     Perm(epoch)))
+                 .first;
+        if (perm_cache_.size() > 4) perm_cache_.erase(perm_cache_.begin());
+      }
+      perm_p = it->second;
+    }
+    const std::vector<uint32_t>& perm = *perm_p;
+    out->index = global_idx;
+    if (normalize_)
+      out->x.resize(static_cast<size_t>(batch_) * kH * kW * kC);
+    else
+      out->xb.resize(static_cast<size_t>(batch_) * kH * kW * kC);
+    out->y.resize(batch_);
+    for (int b = 0; b < batch_; ++b) {
+      const unsigned char* rec = reinterpret_cast<const unsigned char*>(
+          data_.data() +
+          static_cast<size_t>(perm[slot * batch_ + b]) * kRecordBytes);
+      out->y[b] = rec[0];
+      const unsigned char* px = rec + 1;  // channel-major [3][32][32]
+      if (normalize_) {
+        float* dst = out->x.data() + static_cast<size_t>(b) * kH * kW * kC;
+        for (int c = 0; c < kC; ++c) {
+          const float inv = 1.0f / (255.0f * kStd[c]);
+          const float off = kMean[c] / kStd[c];
+          for (int hw = 0; hw < kH * kW; ++hw)
+            dst[hw * kC + c] =
+                static_cast<float>(px[c * kH * kW + hw]) * inv - off;
+        }
+      } else {
+        // raw mode: transpose CHW->NHWC only; 4x less host->device traffic,
+        // normalization fuses into the device step instead
+        uint8_t* dst = out->xb.data() + static_cast<size_t>(b) * kH * kW * kC;
+        for (int c = 0; c < kC; ++c)
+          for (int hw = 0; hw < kH * kW; ++hw)
+            dst[hw * kC + c] = px[c * kH * kW + hw];
+      }
+    }
+  }
+
+  void Work() {
+    for (;;) {
+      long ticket;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_full_.wait(lk, [&] {
+          return stop_ || next_ticket_ < next_out_ + depth_;
+        });
+        if (stop_) return;
+        ticket = next_ticket_++;
+      }
+      Batch b;
+      Assemble(ticket, &b);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        ready_[ticket] = std::move(b);
+      }
+      cv_empty_.notify_all();
+    }
+  }
+
+  const int batch_;
+  const uint64_t seed_;
+  const int depth_;
+  const bool normalize_;
+  std::string error_;
+  std::vector<char> data_;
+  size_t records_ = 0;
+
+  mutable std::mutex perm_mu_;
+  mutable std::map<long, std::shared_ptr<const std::vector<uint32_t>>>
+      perm_cache_;
+
+  std::mutex mu_;
+  std::condition_variable cv_full_, cv_empty_;
+  std::map<long, Batch> ready_;
+  long next_ticket_ = 0;
+  long next_out_ = 0;
+  bool stop_ = false;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_create(const char* dir, int batch, uint64_t seed, int depth,
+                int workers, int normalize) {
+  auto* l = new Loader(dir, batch, seed, depth, workers, normalize != 0);
+  return l;
+}
+
+const char* dl_error(void* h) { return static_cast<Loader*>(h)->error(); }
+
+long dl_num_samples(void* h) {
+  return static_cast<Loader*>(h)->num_samples();
+}
+
+long dl_next(void* h, void* x, int32_t* y) {
+  return static_cast<Loader*>(h)->Next(x, y);
+}
+
+void dl_destroy(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

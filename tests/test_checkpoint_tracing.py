@@ -87,6 +87,7 @@ def test_max_to_keep_prunes(tmp_path):
     state = {"w": jnp.arange(4.0)}
     for s in range(4):
         ckpt.save(s, state)
+    assert ckpt.steps() == [2, 3]  # 0 and 1 pruned
     assert ckpt.latest_step() == 3
     restored = ckpt.restore(3)
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
