@@ -45,6 +45,13 @@ from ddl25spring_tpu.utils.metrics import RunResult, fedavg_message_count
 from ddl25spring_tpu.utils.prng import client_round_key
 
 
+def dropout_key(client_key: jax.Array, epoch, batch_idx) -> jax.Array:
+    """The per-(epoch, batch) dropout key schedule shared by FedAvg's local
+    epochs and FedSGD's single full-batch pass — both servers consuming the
+    same stream is what makes the A1 equivalence exact under dropout."""
+    return jax.random.fold_in(jax.random.fold_in(client_key, epoch), batch_idx)
+
+
 def _model_loss(model):
     def loss_fn(params, x, y, key):
         out = model.apply(
@@ -202,21 +209,35 @@ def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
 
     def local_update(params, x, y, key):
         max_n = x.shape[0]
-        b = max_n if batch_size == -1 else min(batch_size, max_n)
+        full_batch = batch_size == -1 or batch_size >= max_n
+        b = max_n if full_batch else batch_size
         nb = max_n // b
         opt_state = tx.init(params)
 
-        def epoch(carry, ekey):
+        def epoch(carry, e):
             params, opt_state = carry
-            perm = jax.random.permutation(jax.random.fold_in(ekey, 0), max_n)
-            xb = x[perm[: nb * b]].reshape((nb, b) + x.shape[1:])
-            yb = y[perm[: nb * b]].reshape((nb, b))
+            ekey = jax.random.fold_in(key, e)
+            if full_batch:
+                # no shuffle: dropout masks are positional, and keeping row
+                # order (and the dropout_key(key, 0, 0) schedule below) is
+                # what makes FedAvg(B=-1, E=1) bit-match FedSGD — the
+                # homework-A1 oracle, which the reference gets from both
+                # variants consuming one seeded RNG stream identically
+                xb, yb = x[None], y[None]
+            else:
+                # nb+1 never collides with the bstep keys (batch idx < nb)
+                perm = jax.random.permutation(
+                    jax.random.fold_in(ekey, nb + 1), max_n
+                )
+                xb = x[perm[: nb * b]].reshape((nb, b) + x.shape[1:])
+                yb = y[perm[: nb * b]].reshape((nb, b))
 
             def bstep(carry, batch):
                 params, opt_state, i = carry
                 bx, by = batch
-                bkey = jax.random.fold_in(ekey, i + 1)
-                grads = jax.grad(loss_fn)(params, bx, by, bkey)
+                grads = jax.grad(loss_fn)(
+                    params, bx, by, dropout_key(key, e, i)
+                )
                 updates, opt_state = tx.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), opt_state, i + 1), None
 
@@ -225,8 +246,9 @@ def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
             )
             return (params, opt_state), None
 
-        ekeys = jax.random.split(key, nr_epochs)
-        (params, _), _ = jax.lax.scan(epoch, (params, opt_state), ekeys)
+        (params, _), _ = jax.lax.scan(
+            epoch, (params, opt_state), jnp.arange(nr_epochs)
+        )
         return params
 
     return local_update
@@ -292,7 +314,8 @@ class FedSgdGradientServer(_HflBase):
                 # batch_size=len(data) FedSGD (hfl_complete.py:235)
                 def masked_loss(p):
                     out = self.model.apply(
-                        {"params": p}, x, train=True, rngs={"dropout": key}
+                        {"params": p}, x, train=True,
+                        rngs={"dropout": dropout_key(key, 0, 0)},
                     ).astype(jnp.float32)
                     picked = jnp.take_along_axis(out, y[:, None], -1)[:, 0]
                     real = jnp.arange(x.shape[0]) < count

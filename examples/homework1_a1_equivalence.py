@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Homework 1, part A1 — FedSGD-with-gradients == FedSGD-with-weights.
+
+The reference's strongest correctness idea (SURVEY §4): running FedSGD by
+shipping *gradients* must match running it by shipping *weights* — i.e.
+``FedAvgServer`` with full-batch clients and one local epoch — to within
+0.02% test accuracy per round (``lab/series01.ipynb`` cells 9-12; blank
+assignment ``lab/homework-1.ipynb`` cell 9).
+
+Both servers here are vmapped-client TPU implementations; the equivalence
+holds because one full-batch SGD step followed by weighted weight-averaging
+is linear in the gradients.  Run: ``python examples/homework1_a1_equivalence.py
+[--rounds 10] [--clients 10]``.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ddl25spring_tpu.fl import FedAvgServer, FedSgdGradientServer  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=10)
+    ap.add_argument("--fraction", type=float, default=0.1)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=10)  # homework-mandated seed
+    args = ap.parse_args(argv)
+
+    common = dict(
+        nr_clients=args.clients,
+        client_fraction=args.fraction,
+        lr=args.lr,
+        seed=args.seed,
+    )
+    # scenario per series01.ipynb cell 12: weights variant = FedAvg with
+    # batch_size=len(data) (B=-1) and E=1
+    grad_server = FedSgdGradientServer(
+        batch_size=-1, nr_local_epochs=1, **common
+    )
+    weight_server = FedAvgServer(batch_size=-1, nr_local_epochs=1, **common)
+
+    print(f"{'round':>5} {'grad acc':>9} {'weight acc':>10} {'|delta|':>8}")
+    worst = 0.0
+    for r in range(args.rounds):
+        grad_server.round(r)
+        weight_server.round(r)
+        ga = grad_server.test_accuracy()
+        wa = weight_server.test_accuracy()
+        worst = max(worst, abs(ga - wa))
+        print(f"{r:>5} {ga:>9.4f} {wa:>10.4f} {abs(ga - wa):>8.5f}")
+
+    tol = 2e-4  # the homework's 0.02%
+    verdict = "PASS" if worst <= tol else "FAIL"
+    print(f"max |delta| = {worst:.6f} (tolerance {tol}) -> {verdict}")
+    return 0 if worst <= tol else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
