@@ -21,11 +21,12 @@ reference's arithmetic seed (``:289``).  Weighted aggregation
 
 Padding note: clients' shards are padded to rectangular arrays by repeating
 their own examples (see ``data/splitter.stack_client_data``); aggregation
-weights use TRUE sample counts.  FedSGD's full-batch gradient masks the pad
-rows (so it is the exact gradient over the client's real shard, matching the
-reference's ``batch_size=len(data)`` semantics); FedAvg's local epochs see
-the repeats, a slight oversampling of small clients confined to their own
-local training.
+weights use TRUE sample counts.  Both servers mask pad rows out of local
+training: FedSGD's full-batch gradient is the exact gradient over the
+client's real shard (reference ``batch_size=len(data)`` semantics), and
+FedAvg's local epochs shuffle only the real rows and mask any pad that
+lands in a batch — each real example is seen exactly once per epoch, per
+the reference's per-client ``DataLoader`` (``hfl_complete.py:71-80``).
 """
 
 from __future__ import annotations
@@ -40,7 +41,7 @@ import optax
 from ddl25spring_tpu.data.mnist import load_mnist
 from ddl25spring_tpu.data.splitter import split_indices, stack_client_data
 from ddl25spring_tpu.models.mnist_cnn import MnistCnn
-from ddl25spring_tpu.ops.losses import nll_loss
+from ddl25spring_tpu.ops.losses import masked_nll_loss, nll_loss
 from ddl25spring_tpu.utils.metrics import RunResult, fedavg_message_count
 from ddl25spring_tpu.utils.prng import client_round_key
 
@@ -203,15 +204,35 @@ def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
     """One client's local training: E epochs of minibatch SGD, as nested
     scans (epochs over shuffled batches) — vmappable over the client axis.
     Parity: ``WeightClient.update`` -> ``train_epoch``
-    (``hfl_complete.py:71-80,322-332``)."""
-    loss_fn = _model_loss(model)
+    (``hfl_complete.py:71-80,322-332``).
+
+    ``count`` is the client's TRUE shard size; rows ``>= count`` are pads
+    (repeats from ``stack_client_data``) and are excluded from training:
+    the shuffle sorts pads last so real rows occupy positions
+    ``[0, count)`` of the epoch order, and the per-batch loss masks any
+    row whose shuffled position is past ``count``.  Each real example is
+    therefore seen exactly once per epoch — the reference's per-client
+    ``DataLoader`` semantics (``hfl_complete.py:71-80``, drop_last=False)
+    — and the result is invariant to pad-row contents.  A batch made
+    entirely of pads contributes a zero gradient (plain SGD: a no-op).
+    """
     tx = optax.sgd(lr)
 
-    def local_update(params, x, y, key):
+    def masked_loss(params, bx, by, bmask, key):
+        out = model.apply(
+            {"params": params}, bx, train=True, rngs={"dropout": key}
+        )
+        return masked_nll_loss(out, by, bmask)
+
+    def local_update(params, x, y, key, count=None):
         max_n = x.shape[0]
+        if count is None:
+            count = jnp.int32(max_n)
         full_batch = batch_size == -1 or batch_size >= max_n
         b = max_n if full_batch else batch_size
-        nb = max_n // b
+        # ceil: the reference's DataLoader keeps the partial last batch
+        nb = 1 if full_batch else -(-max_n // b)
+        pad_to = nb * b
         opt_state = tx.init(params)
 
         def epoch(carry, e):
@@ -224,25 +245,36 @@ def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
                 # homework-A1 oracle, which the reference gets from both
                 # variants consuming one seeded RNG stream identically
                 xb, yb = x[None], y[None]
+                pos = jnp.arange(max_n)[None]
             else:
+                # uniform shuffle of the real rows with pads sorted last:
+                # positions [0, count) of the order are exactly the
+                # client's shard in random order.
                 # nb+1 never collides with the bstep keys (batch idx < nb)
-                perm = jax.random.permutation(
-                    jax.random.fold_in(ekey, nb + 1), max_n
+                r = jax.random.uniform(
+                    jax.random.fold_in(ekey, nb + 1), (max_n,)
                 )
-                xb = x[perm[: nb * b]].reshape((nb, b) + x.shape[1:])
-                yb = y[perm[: nb * b]].reshape((nb, b))
+                perm = jnp.argsort(jnp.where(jnp.arange(max_n) < count, r, 2.0))
+                extra = pad_to - max_n
+                if extra:
+                    perm = jnp.concatenate([perm, jnp.zeros(extra, perm.dtype)])
+                xb = x[perm].reshape((nb, b) + x.shape[1:])
+                yb = y[perm].reshape((nb, b))
+                pos = jnp.arange(pad_to).reshape(nb, b)
+
+            mask = (pos < count).astype(jnp.float32)
 
             def bstep(carry, batch):
                 params, opt_state, i = carry
-                bx, by = batch
-                grads = jax.grad(loss_fn)(
-                    params, bx, by, dropout_key(key, e, i)
+                bx, by, bm = batch
+                grads = jax.grad(masked_loss)(
+                    params, bx, by, bm, dropout_key(key, e, i)
                 )
                 updates, opt_state = tx.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), opt_state, i + 1), None
 
             (params, opt_state, _), _ = jax.lax.scan(
-                bstep, (params, opt_state, 0), (xb, yb)
+                bstep, (params, opt_state, 0), (xb, yb, mask)
             )
             return (params, opt_state), None
 
@@ -254,6 +286,29 @@ def _make_local_epochs_fn(model, lr: float, batch_size: int, nr_epochs: int):
     return local_update
 
 
+def make_fedavg_round(model, lr: float, batch_size: int, nr_epochs: int):
+    """Jitted one-round FedAvg: vmapped local training over the client axis
+    followed by the sample-count-weighted average (``hfl_complete.py:370-383``).
+    Module-level so the driver dryrun exercises the same round the server
+    ships, not a copy."""
+    local = _make_local_epochs_fn(model, lr, batch_size, nr_epochs)
+
+    @jax.jit
+    def fedavg_round(params, cx, cy, counts, keys):
+        # all chosen clients train in parallel on the client axis —
+        # the TPU-native version of the reference's max-over-times model
+        client_params = jax.vmap(local, in_axes=(None, 0, 0, 0, 0))(
+            params, cx, cy, keys, counts.astype(jnp.int32)
+        )
+        w = counts / counts.sum()  # hfl_complete.py:370-372
+        return jax.tree.map(
+            lambda stacked: jnp.tensordot(w, stacked, axes=1),
+            client_params,
+        )
+
+    return fedavg_round
+
+
 class FedAvgServer(_HflBase):
     """FedAvg: chosen clients train locally for E epochs, server takes the
     sample-count-weighted average of returned weights
@@ -261,22 +316,7 @@ class FedAvgServer(_HflBase):
 
     def __init__(self, *args, **kw):
         super().__init__(*args, algorithm="FedAvg", **kw)
-        local = _make_local_epochs_fn(self.model, self.lr, self.b, self.e)
-
-        @jax.jit
-        def fedavg_round(params, cx, cy, counts, keys):
-            # all chosen clients train in parallel on the client axis —
-            # the TPU-native version of the reference's max-over-times model
-            client_params = jax.vmap(local, in_axes=(None, 0, 0, 0))(
-                params, cx, cy, keys
-            )
-            w = counts / counts.sum()  # hfl_complete.py:370-372
-            return jax.tree.map(
-                lambda stacked: jnp.tensordot(w, stacked, axes=1),
-                client_params,
-            )
-
-        self._round = fedavg_round
+        self._round = make_fedavg_round(self.model, self.lr, self.b, self.e)
 
     def round(self, r: int) -> None:
         chosen = self.sample_clients()
@@ -316,10 +356,9 @@ class FedSgdGradientServer(_HflBase):
                     out = self.model.apply(
                         {"params": p}, x, train=True,
                         rngs={"dropout": dropout_key(key, 0, 0)},
-                    ).astype(jnp.float32)
-                    picked = jnp.take_along_axis(out, y[:, None], -1)[:, 0]
+                    )
                     real = jnp.arange(x.shape[0]) < count
-                    return -(picked * real).sum() / count
+                    return masked_nll_loss(out, y, real, denom=count)
 
                 return jax.grad(masked_loss)(params)
 

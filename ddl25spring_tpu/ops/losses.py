@@ -24,6 +24,28 @@ def nll_loss(log_probs: jax.Array, labels: jax.Array) -> jax.Array:
     return -picked.mean()
 
 
+def masked_nll_loss(
+    log_probs: jax.Array,
+    labels: jax.Array,
+    mask: jax.Array,
+    denom: jax.Array | None = None,
+) -> jax.Array:
+    """NLL over the rows where ``mask`` is 1, averaged over ``denom``
+    (default: the number of unmasked rows, floored at 1 so an all-pad
+    batch yields a zero constant -> zero gradient).
+
+    The single masked-NLL used by both FedAvg's local epochs and FedSGD's
+    full-shard client gradient — sharing it is what keeps the homework-A1
+    FedSGD==FedAvg(B=-1,E=1) oracle exact.
+    """
+    lp = log_probs.astype(jnp.float32)
+    picked = jnp.take_along_axis(lp, labels[:, None], axis=-1)[:, 0]
+    mask = mask.astype(jnp.float32)
+    if denom is None:
+        denom = jnp.maximum(mask.sum(), 1.0)
+    return -(picked * mask).sum() / denom
+
+
 def cross_entropy_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy from raw logits (``nn.CrossEntropyLoss``)."""
     logits = logits.astype(jnp.float32)

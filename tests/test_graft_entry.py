@@ -1,8 +1,14 @@
 """Driver-contract tests for __graft_entry__.py."""
 
+import os
+import subprocess
+import sys
+
 import jax
 
 import __graft_entry__
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_entry_compiles_and_runs():
@@ -11,5 +17,22 @@ def test_entry_compiles_and_runs():
     assert loss == loss and loss > 0  # finite, positive
 
 
-def test_dryrun_multichip_8():
-    __graft_entry__.dryrun_multichip(8)
+def test_dryrun_multichip_fresh_subprocess():
+    """Simulate the driver: run dryrun_multichip in a fresh interpreter
+    WITHOUT conftest's platform forcing — dryrun_multichip itself must
+    select the CPU platform (MULTICHIP_r01 failed exactly here).  This is
+    a strict superset of an in-process dryrun call, which it replaces to
+    keep the suite from paying the ~3-minute dryrun twice."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"dryrun_multichip subprocess failed:\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "dryrun_multichip DPxPP OK" in proc.stdout
