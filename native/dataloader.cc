@@ -17,9 +17,10 @@
 // worker finishes first (per-slot reordering).
 //
 // C ABI (ctypes-consumed; see ddl25spring_tpu/data/native_loader.py):
-//   dl_create(dir, batch, seed, depth, workers) -> handle (0 on error)
-//   dl_num_samples(h), dl_batch_bytes_x(h), dl_error(h)
-//   dl_next(h, float* x, int32* y) -> epoch of the batch (>=0), blocking
+//   dl_create(dir, batch, seed, depth, workers, normalize) -> handle (0 on error)
+//   dl_num_samples(h), dl_error(h)
+//   dl_next(h, void* x, int32* y) -> epoch of the batch (>=0), blocking
+//     (x is float32 when normalize!=0, uint8 NHWC otherwise)
 //   dl_destroy(h)
 //
 // CIFAR-10 record format: 1 label byte + 3072 channel-major pixel bytes
@@ -127,7 +128,8 @@ class Loader {
     records_ += n;
   }
 
-  // Per-epoch deterministic permutation: mt19937_64(seed ^ epoch).
+  // Per-epoch deterministic permutation:
+  // mt19937_64(seed + golden_ratio_odd * (epoch + 1)).
   std::vector<uint32_t> Perm(long epoch) const {
     std::vector<uint32_t> idx(records_);
     std::iota(idx.begin(), idx.end(), 0u);
