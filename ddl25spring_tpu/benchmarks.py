@@ -1,0 +1,136 @@
+"""Shared builder for the BASELINE.json north-star benchmark workload.
+
+Both the headline ``bench.py`` and the ``lab/s01_b2_dp_pp.py`` driver
+(`run-b2.sh`) construct the ResNet-18/CIFAR-10 DP(+PP) train step from
+here, so the bench can never drift from what the launcher actually runs.
+
+The returned step takes a RAW uint8 batch ``(x_u8 [B,32,32,3], y [B])`` and
+normalizes on device *inside* the jit boundary — 4x less host->device
+traffic than fp32, and XLA fuses the normalize into the first conv's input
+pipeline.  Parity anchor: the benchmark config of ``lab/run-b2.sh``
+(reference: ``lab/s01_b2_dp_pp.py:93-227``, retargeted per BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ddl25spring_tpu.data.native_loader import normalize_on_device
+from ddl25spring_tpu.models.resnet import (
+    ResNet18,
+    ResNet18Stage0,
+    ResNet18Stage1,
+)
+from ddl25spring_tpu.ops.losses import cross_entropy_logits
+from ddl25spring_tpu.parallel.dp import make_dp_train_step
+from ddl25spring_tpu.parallel.het_pipeline import make_het_pipeline_train_step
+from ddl25spring_tpu.utils.mesh import make_mesh
+
+
+def build_resnet_step(
+    devices: list,
+    dp: int,
+    S: int,
+    num_microbatches: int,
+    batch: int,
+    lr: float = 0.1,
+    dtype: Any = None,
+):
+    """Build the north-star train step on ``devices[: dp * S]``.
+
+    ``S == 2`` -> the 2-stage heterogeneous pipeline x DP (``layout
+    "dppp"``); ``S == 1`` -> pure DP.  Returns ``(step, params, opt_state,
+    meta)`` where ``step(params, opt_state, (x_u8, y))`` is jitted and
+    ``meta`` carries layout/topology strings and chip count for reporting.
+    """
+    if S not in (1, 2):
+        raise ValueError(f"resnet pipeline supports S in (1, 2), got {S}")
+    n_used = dp * S
+    M = num_microbatches if S == 2 else 1
+    if batch % (dp * M):
+        raise ValueError(f"batch {batch} not divisible by dp*M = {dp * M}")
+    if dtype is None:
+        dtype = jnp.bfloat16 if devices[0].platform == "tpu" else jnp.float32
+    tx = optax.sgd(lr, momentum=0.9)
+    x8 = jnp.zeros((8, 32, 32, 3), jnp.float32)
+
+    if S == 2:
+        mesh = (
+            make_mesh(devices[:n_used], data=dp, stage=S)
+            if dp > 1
+            else make_mesh(devices[:2], stage=2)
+        )
+        s0, s1 = ResNet18Stage0(dtype=dtype), ResNet18Stage1(dtype=dtype)
+        p0 = s0.init(jax.random.PRNGKey(0), x8)["params"]
+        mid = s0.apply({"params": p0}, x8)
+        p1 = s1.init(jax.random.PRNGKey(1), mid)["params"]
+        params = (p0, p1)
+        mb = batch // M // dp
+        inner = make_het_pipeline_train_step(
+            [lambda p, h: s0.apply({"params": p}, h),
+             lambda p, h: s1.apply({"params": p}, h)],
+            lambda logits, b: cross_entropy_logits(logits, b["y"]),
+            (mb, 32, 32, 3), [(mb,) + mid.shape[1:], (mb, 10)],
+            tx, mesh, M, data_axis="data" if dp > 1 else None,
+            compute_dtype=dtype,
+        )
+
+        @jax.jit
+        def step(params, opt_state, raw):
+            x = normalize_on_device(raw[0], dtype)
+            return inner(params, opt_state, {"x": x, "y": raw[1]})
+
+        layout = "dppp"
+        topo = f"mesh(data={dp}, stage={S}), microbatches={M}"
+    else:
+        mesh = make_mesh(devices[:n_used], data=dp)
+        model = ResNet18(norm="group", dtype=dtype)
+        params = model.init(jax.random.PRNGKey(0), x8)["params"]
+
+        def loss_fn(p, bat, key):
+            xb, yb = bat
+            logits = model.apply({"params": p}, xb.astype(dtype), train=True)
+            return cross_entropy_logits(logits, yb)
+
+        inner = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+        key = jax.random.PRNGKey(1)
+
+        @jax.jit
+        def step(params, opt_state, raw):
+            x = normalize_on_device(raw[0], dtype)
+            return inner(params, opt_state, (x, raw[1]), key)
+
+        layout = "dp"
+        topo = f"mesh(data={dp})"
+
+    opt_state = tx.init(params)
+    meta = {
+        "n_chips": n_used,
+        "batch": batch,
+        "layout": layout,
+        "topology": topo,
+        "device": devices[0],
+        "mesh": mesh,
+    }
+    return step, params, opt_state, meta
+
+
+def timed_run(step, params, opt_state, feed, steps: int, warmup: int):
+    """Warmup (compile) then time ``steps`` calls; returns ``(dt, params,
+    opt_state)``.  Forces completion via a host transfer — on this image's
+    tunneled TPU platform ``block_until_ready`` does not actually block."""
+    loss = None
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, feed())
+    if loss is not None:
+        float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, feed())
+    float(loss)  # the step chain is data-dependent through params
+    return time.perf_counter() - t0, params, opt_state

@@ -20,26 +20,46 @@ MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 
-def _find_dir() -> Path | None:
+def _find_candidate(*markers: str) -> Path | None:
+    """First candidate dir (env var, then standard paths) containing at
+    least one of ``markers``."""
     for cand in (
         os.environ.get("DDL25_CIFAR10_DIR"),
         "data/cifar-10-batches-bin",
         "data/cifar10",
     ):
-        if (
-            cand
-            and Path(cand).exists()
-            and (Path(cand) / "data_batch_1.bin").exists()
-            and (Path(cand) / "test_batch.bin").exists()
+        if cand and Path(cand).exists() and any(
+            (Path(cand) / m).exists() for m in markers
         ):
             return Path(cand)
     return None
 
 
-def _read_bin(path: Path) -> tuple[np.ndarray, np.ndarray]:
+def _find_dir() -> Path | None:
+    """Directory with the full canonical layout (train batches + test split)
+    — what :func:`load_cifar10` needs."""
+    d = _find_candidate("data_batch_1.bin")
+    if d is not None and (d / "test_batch.bin").exists():
+        return d
+    return None
+
+
+def _find_loader_dir() -> Path | None:
+    """Directory usable by the native streaming loader — unlike
+    :func:`_find_dir` this accepts the single-file ``train.bin`` layout and
+    does not require a test split (``native/dataloader.cc`` supports both)."""
+    return _find_candidate("data_batch_1.bin", "train.bin")
+
+
+def _read_bin_u8(path: Path) -> tuple[np.ndarray, np.ndarray]:
     raw = np.frombuffer(path.read_bytes(), dtype=np.uint8).reshape(-1, 3073)
     labels = raw[:, 0].astype(np.int32)
     imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(imgs), labels
+
+
+def _read_bin(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    imgs, labels = _read_bin_u8(path)
     return imgs.astype(np.float32) / 255.0, labels
 
 
@@ -54,6 +74,60 @@ def _synthetic(n: int, seed: int, noise: float = 0.2):
         np.float32
     )
     return np.clip(imgs, 0.0, 1.0), labels
+
+
+def ensure_bin_dir(
+    n_records: int = 50_000, seed: int = 0, synth_dir: str = "data/cifar10-synth-bin"
+) -> tuple[Path, str]:
+    """Directory of CIFAR-10 binary batches for the native streaming loader.
+
+    Returns ``(dir, provenance)`` where provenance is ``"real"`` when the
+    canonical binaries are present (``DDL25_CIFAR10_DIR`` / data dirs) and
+    ``"synthetic"`` otherwise — in which case a CIFAR-format ``train.bin``
+    is written once (uint8 quantization of :func:`_synthetic`) so the C++
+    prefetcher exercises its real parse/shuffle/assemble path and benchmarks
+    measure true input-pipeline cost even on a zero-egress image.
+    """
+    d = _find_loader_dir()
+    if d is not None:
+        return d, "real"
+    out = Path(synth_dir)
+    f = out / "train.bin"
+    want_bytes = n_records * 3073
+    if not (f.exists() and f.stat().st_size == want_bytes):
+        out.mkdir(parents=True, exist_ok=True)
+        imgs, labels = _synthetic(n_records, seed)
+        chw = np.round(imgs.transpose(0, 3, 1, 2) * 255.0).astype(np.uint8)
+        rec = np.empty((n_records, 3073), np.uint8)
+        rec[:, 0] = labels.astype(np.uint8)
+        rec[:, 1:] = chw.reshape(n_records, -1)
+        tmp = f.with_suffix(".bin.tmp")
+        tmp.write_bytes(rec.tobytes())
+        tmp.replace(f)
+    return out, "synthetic"
+
+
+@lru_cache(maxsize=1)
+def load_cifar10_u8(n_train: int = 50_000, seed: int = 0):
+    """Raw uint8 NHWC training images + int32 labels (real binaries when
+    present, quantized synthetic otherwise) — the device-side-normalization
+    input format (pair with ``native_loader.normalize_on_device``).  Always
+    returns exactly ``n_train`` rows (short real datasets are tiled)."""
+    d = _find_loader_dir()
+    if d is not None:
+        parts = sorted(d.glob("data_batch_*.bin")) or [d / "train.bin"]
+        xs, ys = zip(*(_read_bin_u8(p) for p in parts))
+        x, y = np.concatenate(xs), np.concatenate(ys)
+        provenance = "real"
+        if len(x) < n_train:
+            reps = -(-n_train // len(x))
+            x = np.tile(x, (reps, 1, 1, 1))
+            y = np.tile(y, reps)
+    else:
+        x01, y = _synthetic(n_train, seed)
+        x = np.round(x01 * 255.0).astype(np.uint8)
+        provenance = "synthetic"
+    return {"x": x[:n_train], "y": y[:n_train], "provenance": provenance}
 
 
 @lru_cache(maxsize=1)

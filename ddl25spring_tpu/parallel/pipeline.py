@@ -152,6 +152,207 @@ def make_pipeline_loss(
     return loss
 
 
+def make_1f1b_value_and_grad(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """1F1B: the memory-bounded pipeline schedule, hand-rolled backward.
+
+    The reference names 1F1B explicitly (single-batch forward/backward chain,
+    ``lab/tutorial_1b/PP/1F1B/intro_PP_1F1B.py:50-95``); its defining
+    production property — which GPipe lacks — is the *bounded activation
+    live-range*: a stage starts draining backwards before all M microbatch
+    forwards have streamed through, so in-flight activations stay O(S)
+    instead of O(M).
+
+    The GPipe path here gets backward from the scan transpose, which saves
+    every tick's residuals (attention internals included) across all
+    ``M + S - 1`` ticks — memory grows linearly in M.  That cannot express
+    1F1B, so this schedule writes the backward by hand:
+
+    - tick ``t``: stage ``s`` runs the forward of microbatch ``t - s``
+      (GPipe timing) AND the backward of microbatch ``t - (2(S-1) - s)`` —
+      in the steady state every stage does one forward and one backward per
+      tick, which is exactly 1F1B;
+    - each stage stashes only its *input* activation per in-flight
+      microbatch in a ring buffer of ``2S - 1`` slots (+1 scratch) — the
+      live-range ``2(S-1-s)`` ticks never exceeds it — and the backward
+      tick recomputes its stage forward from the stash under ``jax.vjp``
+      (rematerialization: one extra stage-forward per microbatch, the
+      standard memory/FLOPs trade, cf. ``jax.checkpoint``);
+    - boundary cotangents ride a reverse ``ppermute`` (stage ``s`` ->
+      ``s - 1``), the mirror of the forward activation hop;
+    - schedule length is ``M + 2(S-1)`` ticks vs GPipe's ``M + S - 1``
+      forward ticks + transpose drain.
+
+    Activation stash: ``(2S-1) * mb * L * dmodel`` elements, M-invariant —
+    vs GPipe's ``(M+S-1)`` tick carries *plus* per-tick block internals.
+    Grad/loss equality with GPipe and the serial model is asserted in
+    ``tests/test_pipeline.py``.
+
+    Returns ``f(params, tokens) -> (loss, grads)`` with the same contract as
+    ``jax.value_and_grad(make_pipeline_loss(...))``.
+    """
+    S = mesh.shape[stage_axis]
+    M = num_microbatches
+    dtype = jnp.dtype(cfg.dtype)
+    K = 2 * S - 1  # ring slots; slot K is scratch for inactive ticks
+
+    tok_spec = P(None, data_axis)
+    grad_out_specs = {
+        "embed": P(),
+        "blocks": P(stage_axis),
+        "ln_f": P(),
+        "unembed": P(),
+    }
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(staged_param_specs(stage_axis), tok_spec),
+        out_specs=(P(), grad_out_specs),
+    )
+    def value_and_grad(params: Params, tokens_mb: jax.Array):
+        local_blocks = jax.tree.map(lambda x: x[0], params["blocks"])
+        s = lax.axis_index(stage_axis)
+        mb, L = tokens_mb.shape[1], tokens_mb.shape[2]
+        axes = (stage_axis,) + ((data_axis,) if data_axis else ())
+
+        head = lax.pcast(
+            {k: params[k] for k in ("embed", "ln_f", "unembed")},
+            axes,
+            to="varying",
+        )
+        vblocks = lax.pcast(local_blocks, tuple(
+            a for a in axes if a != stage_axis
+        ), to="varying") if data_axis else local_blocks
+
+        is_last = s == S - 1
+
+        def local_fwd_loss(blocks, hd, x_in, tok):
+            """This stage's slice of the model, as one differentiable fn:
+            stage 0 prepends embed, the last stage appends unembed+loss."""
+            x_in = lax.cond(
+                s == 0,
+                lambda x: llama.embed(hd, tok, cfg),
+                lambda x: x,
+                x_in,
+            )
+            x_out = llama.apply_blocks(blocks, x_in, cfg)
+            loss = lax.cond(
+                is_last,
+                lambda x: causal_lm_loss(llama.unembed(hd, x, cfg), tok),
+                lambda x: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                x_out,
+            )
+            return x_out, loss
+
+        def tick(carry, t):
+            fwd_in, cot_in, ring, gblocks, ghead, loss_sum = carry
+
+            # ---- forward slot: GPipe timing (mb f at tick f + s) ----------
+            f_idx = t - s
+            fwd_active = jnp.logical_and(f_idx >= 0, f_idx < M)
+            tok_f = tokens_mb[jnp.clip(f_idx, 0, M - 1)]
+            x_first = llama.embed(head, tok_f, cfg)
+            x_in = jnp.where(s == 0, x_first, fwd_in)
+            # stash the stage INPUT (all the backward needs — the stage body
+            # is recomputed); inactive ticks write the scratch slot
+            ring = lax.dynamic_update_index_in_dim(
+                ring, x_in, jnp.where(fwd_active, f_idx % K, K), axis=0
+            )
+            # the last stage's forward is fully redone by its same-tick
+            # backward below; skip the dead compute
+            x_out = lax.cond(
+                jnp.logical_and(fwd_active, jnp.logical_not(is_last)),
+                lambda x: llama.apply_blocks(local_blocks, x, cfg),
+                lambda x: x,
+                x_in,
+            )
+
+            # ---- backward slot: mb b finishes S-1+b at the last stage and
+            # walks back one stage per tick ---------------------------------
+            b_idx = t - (2 * (S - 1) - s)
+            bwd_active = jnp.logical_and(b_idx >= 0, b_idx < M)
+            x_saved = ring[jnp.clip(jnp.where(bwd_active, b_idx % K, K), 0, K)]
+            tok_b = tokens_mb[jnp.clip(b_idx, 0, M - 1)]
+
+            (x_out_b, loss_b), pull = jax.vjp(
+                lambda b, h, x: local_fwd_loss(b, h, x, tok_b),
+                vblocks, head, x_saved,
+            )
+            # cotangent seed: downstream cotangent for interior stages, the
+            # scalar loss for the last (its x_out feeds nothing but the loss)
+            g_out = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
+            g_loss = jnp.where(
+                is_last, jnp.float32(1.0), jnp.float32(0.0)
+            )
+            g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + g_loss
+            db, dh, dx = pull((g_out.astype(x_out_b.dtype), g_loss))
+
+            w = jnp.where(bwd_active, jnp.float32(1.0), jnp.float32(0.0))
+            gblocks = jax.tree.map(lambda a, g: a + w * g, gblocks, db)
+            ghead = jax.tree.map(lambda a, g: a + w * g, ghead, dh)
+            loss_sum = loss_sum + w * loss_b
+
+            # ---- boundary hops: activations forward, cotangents back ------
+            fwd_next = lax.ppermute(
+                x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            cot_next = lax.ppermute(
+                dx, stage_axis, [(i, (i - 1) % S) for i in range(S)]
+            )
+            return (fwd_next, cot_next, ring, gblocks, ghead, loss_sum), None
+
+        def vzeros(x, dt=None):
+            return lax.pcast(
+                jnp.zeros(jnp.shape(x), dt or jnp.result_type(x)),
+                axes, to="varying",
+            )
+
+        carry0 = (
+            vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # fwd act
+            vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # cotangent
+            vzeros(jnp.empty((K + 1, mb, L, cfg.dmodel)), dtype),  # stash
+            jax.tree.map(lambda x: vzeros(x, jnp.float32), local_blocks),
+            jax.tree.map(lambda x: vzeros(x, jnp.float32), head),
+            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+        )
+        T = M + 2 * (S - 1)
+        (_, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+
+        # mean over microbatches; DP mean over the data axis (the automatic
+        # cotangent psum of the GPipe path, done by hand here)
+        loss = lax.psum(loss_sum, stage_axis) / M
+        gblocks = jax.tree.map(lambda g: g[None] / M, gblocks)
+        ghead = jax.tree.map(lambda g: g / M, ghead)
+        ghead = jax.tree.map(lambda g: lax.psum(g, stage_axis), ghead)
+        if data_axis is not None:
+            loss = lax.pmean(loss, data_axis)
+            gblocks = jax.tree.map(lambda g: lax.pmean(g, data_axis), gblocks)
+            ghead = jax.tree.map(lambda g: lax.pmean(g, data_axis), ghead)
+        grads = {
+            "embed": ghead["embed"],
+            "blocks": gblocks,
+            "ln_f": ghead["ln_f"],
+            "unembed": ghead["unembed"],
+        }
+        return loss, grads
+
+    def f(params: Params, tokens: jax.Array):
+        B, L = tokens.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        return value_and_grad(params, tokens.reshape(M, B // M, L))
+
+    return f
+
+
 def make_pipeline_train_step(
     cfg: LlamaConfig,
     tx: optax.GradientTransformation,
@@ -159,15 +360,32 @@ def make_pipeline_train_step(
     num_microbatches: int,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    schedule: str = "gpipe",
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
-    all_reduce + Adam step (``s01_b2_dp_pp.py:93-227``)."""
-    loss_fn = make_pipeline_loss(cfg, mesh, num_microbatches, stage_axis, data_axis)
+    all_reduce + Adam step (``s01_b2_dp_pp.py:93-227``).
+
+    ``schedule``: ``"gpipe"`` (scan-transpose backward, parity with the
+    homework B1 microbatch solution) or ``"1f1b"`` (memory-bounded
+    interleaved schedule, parity with ``intro_PP_1F1B.py`` generalized to
+    M microbatches — see :func:`make_1f1b_value_and_grad`).
+    """
+    if schedule == "1f1b":
+        vag = make_1f1b_value_and_grad(
+            cfg, mesh, num_microbatches, stage_axis, data_axis
+        )
+    elif schedule == "gpipe":
+        loss_fn = make_pipeline_loss(
+            cfg, mesh, num_microbatches, stage_axis, data_axis
+        )
+        vag = jax.value_and_grad(loss_fn)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
 
     @jax.jit
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        loss, grads = vag(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -1,0 +1,83 @@
+"""FLOPs accounting and MFU (model-FLOPs utilization).
+
+The reference publishes no utilization numbers — its only perf instrument is
+wall-clock (``lab/run-b2.sh:16-17``).  On TPU the honest headline is
+achieved FLOP/s against the chip's bf16 peak; this module derives the
+per-step FLOP count from the *compiled* XLA program (the compiler's own cost
+model, not a hand napkin) and maps ``device_kind`` to the public per-chip
+peak so drivers can print an MFU line next to samples/sec.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+# Public per-chip dense bf16 peaks (FLOP/s).  Matched by prefix against
+# ``jax.Device.device_kind`` (e.g. "TPU v5 lite" -> v5e).  Longest prefix
+# wins so "TPU v5 lite" does not match the "TPU v5" (v5p) entry.
+PEAK_BF16_FLOPS: dict[str, float] = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,        # v5p reports kind "TPU v5"
+    "TPU v6 lite": 918e12,   # Trillium / v6e
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,        # Ironwood (dense fp8 is higher; bf16 peak)
+}
+
+
+def chip_peak_flops(device: jax.Device | None = None) -> float | None:
+    """Per-chip bf16 peak FLOP/s for ``device`` (default: ``jax.devices()[0]``),
+    or None when the platform has no meaningful MXU peak (CPU simulation)."""
+    d = device if device is not None else jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = getattr(d, "device_kind", "") or ""
+    best = None
+    for prefix, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
+            best = (len(prefix), peak)
+    return best[1] if best else None
+
+
+def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> float | None:
+    """Total FLOPs of one invocation per XLA's cost analysis of the compiled
+    program (fwd + bwd + optimizer — everything inside the jit boundary).
+
+    Hits the jit cache when the function was already called with these
+    shapes.  Returns None where the backend exposes no cost model.
+    """
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(
+    flops_per_step: float | None,
+    step_time_s: float,
+    n_chips: int = 1,
+    device: jax.Device | None = None,
+) -> tuple[float | None, float | None]:
+    """Return ``(achieved_tflops_per_chip, mfu_fraction)``.
+
+    ``flops_per_step`` is the whole-mesh program's FLOPs (XLA cost analysis
+    counts the full sharded computation); both outputs are per chip.  Either
+    element is None when its ingredient is unavailable.
+    """
+    if flops_per_step is None or step_time_s <= 0:
+        return None, None
+    achieved = flops_per_step / step_time_s / max(n_chips, 1)
+    peak = chip_peak_flops(device)
+    frac = achieved / peak if peak else None
+    return achieved / 1e12, frac

@@ -38,6 +38,16 @@ def parse_args(argv=None):
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
                     help="simulate an N-device mesh on CPU")
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+                    help="pipeline schedule: gpipe (homework B1 parity) or "
+                         "1f1b (memory-bounded; activation stash O(S) not "
+                         "O(M))")
+    ap.add_argument("--no-flash", action="store_true",
+                    help="disable the Pallas flash-attention kernel (on TPU "
+                         "it is ON by default; CPU always runs dense)")
+    ap.add_argument("--trace-dir", default="",
+                    help="capture a jax.profiler trace of the timed loop "
+                         "(Perfetto/TensorBoard-loadable)")
     return ap.parse_args(argv)
 
 
@@ -66,11 +76,16 @@ def main(argv=None) -> None:
     from ddl25spring_tpu.utils.mesh import make_mesh
 
     devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
     tokenizer = get_tokenizer()
+    # fastest correct path by default: the Pallas flash kernel on TPU
+    # (measured 1.8x at ctx 4096), dense attention on CPU where Pallas
+    # would run interpreted
     cfg = LlamaConfig(
         vocab_size=tokenizer.vocab_size, dmodel=288, num_heads=6,
         n_layers=6, ctx_size=args.seq_len,
-        dtype="bfloat16" if devices[0].platform == "tpu" else "float32",
+        dtype="bfloat16" if on_tpu else "float32",
+        use_flash=on_tpu and not args.no_flash,
     )
     S = args.stages or max(
         s for s in (6, 3, 2, 1) if s <= len(devices) and cfg.n_layers % s == 0
@@ -78,29 +93,48 @@ def main(argv=None) -> None:
     mesh = make_mesh(devices[:S], stage=S)
     print(f"devices={len(devices)} ({devices[0].platform}) -> "
           f"pipeline stages={S}, microbatches={args.microbatches}, "
-          f"batch={args.batch}")
+          f"batch={args.batch}, schedule={args.schedule}, "
+          f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
     staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
     tx = optax.adam(args.lr)
     opt_state = tx.init(staged)
-    step = make_pipeline_train_step(cfg, tx, mesh, args.microbatches)
+    step = make_pipeline_train_step(
+        cfg, tx, mesh, args.microbatches, schedule=args.schedule
+    )
 
     ds = iter(TinyStories(tokenizer, batch_size=args.batch, seq_l=args.seq_len))
     # warmup outside the timer: jit compile dominates the first step
     staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
     float(loss)
+
+    import contextlib
+
+    from ddl25spring_tpu.utils.flops import compiled_flops, mfu
+    from ddl25spring_tpu.utils.tracing import trace
+
+    ctx = trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     t0 = time.perf_counter()
-    for it in range(args.iters):
-        tokens = jnp.asarray(next(ds))
-        staged, opt_state, loss = step(staged, opt_state, tokens)
-        if it % args.log_every == 0 or it == args.iters - 1:
-            # host transfer forces completion of the async dispatch chain
-            print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
+    with ctx:
+        for it in range(args.iters):
+            tokens = jnp.asarray(next(ds))
+            staged, opt_state, loss = step(staged, opt_state, tokens)
+            if it % args.log_every == 0 or it == args.iters - 1:
+                # host transfer forces completion of the async dispatch chain
+                print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
     dt = time.perf_counter() - t0
+    n_chips = len(mesh.devices.flat)
     tok_s = args.iters * args.batch * args.seq_len / dt
     print(f"done: {args.iters} iters in {dt:.1f}s "
-          f"({tok_s:,.0f} tok/s, {tok_s / len(mesh.devices.flat):,.0f} tok/s/chip)")
+          f"({tok_s:,.0f} tok/s, {tok_s / n_chips:,.0f} tok/s/chip)")
+    fl = compiled_flops(step, staged, opt_state, tokens)
+    tf, frac = mfu(fl, dt / args.iters, n_chips, devices[0])
+    if tf is not None:
+        print(f"achieved {tf:.1f} TFLOP/s/chip"
+              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+    if args.trace_dir:
+        print(f"profiler trace written to {args.trace_dir}")
 
 
 if __name__ == "__main__":

@@ -50,11 +50,23 @@ def parse_args(argv=None):
                     help="llama workload: checkpoint/resume directory; a "
                          "relaunched run continues from the latest step")
     ap.add_argument("--ckpt-every", type=int, default=100)
-    ap.add_argument("--stream", action="store_true",
-                    help="resnet: stream a fresh batch per step through the "
-                         "native C++ prefetching loader (needs real CIFAR-10 "
-                         "binaries via DDL25_CIFAR10_DIR) instead of reusing "
-                         "one device-resident batch")
+    ap.add_argument("--stream", dest="stream", action="store_true",
+                    default=None,
+                    help="resnet: force streaming through the native C++ "
+                         "prefetching loader (synthesizes CIFAR-format "
+                         "binaries if none exist).  Default: auto — stream "
+                         "when real binaries are present "
+                         "(DDL25_CIFAR10_DIR / data/cifar-10-batches-bin)")
+    ap.add_argument("--no-stream", dest="stream", action="store_false",
+                    help="resnet: always reuse one device-resident batch")
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+                    help="llama: pipeline schedule (1f1b bounds activation "
+                         "memory at O(S) instead of O(M))")
+    ap.add_argument("--no-flash", action="store_true",
+                    help="llama: disable the Pallas flash-attention kernel "
+                         "(ON by default on TPU; CPU always runs dense)")
+    ap.add_argument("--trace-dir", default="",
+                    help="capture a jax.profiler trace of the timed loop")
     return ap.parse_args(argv)
 
 
@@ -84,24 +96,30 @@ def run_llama(args, jax, jnp):
         dp, S = 1, 1
     mesh = make_mesh(devices[: dp * S], data=dp, stage=S)
 
+    on_tpu = devices[0].platform == "tpu"
     tokenizer = get_tokenizer()
+    # fastest correct path by default: Pallas flash attention on TPU,
+    # dense on CPU (where Pallas would run interpreted)
     cfg = LlamaConfig(
         vocab_size=tokenizer.vocab_size, dmodel=288, num_heads=6,
         n_layers=6, ctx_size=256,
-        dtype="bfloat16" if devices[0].platform == "tpu" else "float32",
+        dtype="bfloat16" if on_tpu else "float32",
+        use_flash=on_tpu and not args.no_flash,
     )
     M = args.microbatches or 3
     batch = args.batch or 3 * dp  # reference: batch 3 per pipeline
     iters = args.iters or 200
     print(f"llama DPxPP: mesh(data={dp}, stage={S}), batch={batch}, "
-          f"microbatches={M}")
+          f"microbatches={M}, schedule={args.schedule}, "
+          f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
     staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
     tx = optax.adam(args.lr or 8e-4)
     opt_state = tx.init(staged)
     step = make_pipeline_train_step(
-        cfg, tx, mesh, M, data_axis="data" if dp > 1 else None
+        cfg, tx, mesh, M, data_axis="data" if dp > 1 else None,
+        schedule=args.schedule,
     )
 
     start_it = 0
@@ -129,19 +147,29 @@ def run_llama(args, jax, jnp):
     # outputs are DISCARDED — a warmup that stepped the optimizer would give
     # every resumed run one extra update and break kill-and-resume
     # equivalence with an uninterrupted run
-    _ = step(staged, opt_state, jnp.asarray(next(ds)))
+    tokens_w = jnp.asarray(next(ds))
+    _ = step(staged, opt_state, tokens_w)
     float(_[2])
+
+    import contextlib
+
+    from ddl25spring_tpu.utils.tracing import trace
+
+    ctx = trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     t0 = time.perf_counter()
     last_it = start_it - 1
-    for it in range(start_it, start_it + iters):
-        staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
-        if (args.log_every and it % args.log_every == 0) \
-                or it == start_it + iters - 1:
-            print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
-        if ckpt is not None and args.ckpt_every > 0 \
-                and (it + 1) % args.ckpt_every == 0:
-            ckpt.save(it, {"params": staged, "opt_state": opt_state})
-        last_it = it
+    with ctx:
+        for it in range(start_it, start_it + iters):
+            staged, opt_state, loss = step(
+                staged, opt_state, jnp.asarray(next(ds))
+            )
+            if (args.log_every and it % args.log_every == 0) \
+                    or it == start_it + iters - 1:
+                print(f"iter {it:5d}  loss {float(loss):.4f}", flush=True)
+            if ckpt is not None and args.ckpt_every > 0 \
+                    and (it + 1) % args.ckpt_every == 0:
+                ckpt.save(it, {"params": staged, "opt_state": opt_state})
+            last_it = it
     dt = time.perf_counter() - t0
     if ckpt is not None and last_it >= start_it:
         # persist the tail: without this, up to ckpt_every-1 trailing steps
@@ -155,25 +183,24 @@ def run_llama(args, jax, jnp):
     print(f"done: {iters} iters in {dt:.1f}s ({tok_s:,.0f} tok/s, "
           f"{tok_s / (dp * S):,.0f} tok/s/chip)")
 
+    from ddl25spring_tpu.utils.flops import compiled_flops, mfu
+
+    fl = compiled_flops(step, staged, opt_state, tokens_w)
+    tf, frac = mfu(fl, dt / iters, dp * S, devices[0])
+    if tf is not None:
+        print(f"achieved {tf:.1f} TFLOP/s/chip"
+              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+    if args.trace_dir:
+        print(f"profiler trace written to {args.trace_dir}")
+
 
 def run_resnet(args, jax, jnp):
-    import optax
-
-    from ddl25spring_tpu.data.cifar10 import load_cifar10
-    from ddl25spring_tpu.models.resnet import (
-        ResNet18, ResNet18Stage0, ResNet18Stage1,
-    )
-    from ddl25spring_tpu.ops.losses import cross_entropy_logits
-    from ddl25spring_tpu.parallel.dp import make_dp_train_step
-    from ddl25spring_tpu.parallel.het_pipeline import (
-        make_het_pipeline_train_step,
-    )
-    from ddl25spring_tpu.utils.mesh import make_mesh
+    from ddl25spring_tpu.benchmarks import build_resnet_step
+    from ddl25spring_tpu.data.cifar10 import _find_loader_dir, load_cifar10_u8
 
     devices = jax.devices()
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
-    dtype = jnp.bfloat16 if on_tpu else jnp.float32
     iters = args.iters or 30
     warmup = 3
 
@@ -182,113 +209,95 @@ def run_resnet(args, jax, jnp):
     else:
         dp, S = n, 1
     n_used = dp * S  # odd counts strand a device in the --pp layout
+    M = (args.microbatches or 2) if S == 2 else 1
     # CPU simulation can't sustain the TPU-sized default batch: a --pp tick
     # slower than XLA's ~40s collective-rendezvous deadline aborts the
     # process, and full-width conv ticks on fake CPU devices hit that at
     # microbatches of ~16; default to microbatches of ~4
     batch = args.batch or (1024 if on_tpu else 4) * n_used
-    data = load_cifar10(n_train=batch, n_test=8)
-    batch = (min(batch, len(data["x_train"])) // (dp * (args.microbatches or 2))) \
-        * dp * (args.microbatches or 2)
-    x_host = data["x_train"][:batch]
-    y_host = data["y_train"][:batch]
-    # init below only touches x[:8]; the full fixed batch goes to the device
-    # only when it IS the feed (no --stream), so streaming runs don't pin
-    # ~12 MB/1024-batch of dead fp32 in HBM
-    x = jnp.asarray(x_host[:8])
-    tx = optax.sgd(args.lr or 0.1, momentum=0.9)
+    batch = batch // (dp * M) * (dp * M)
 
-    if S == 2:
-        M = args.microbatches or 2
-        mesh = make_mesh(devices, data=dp, stage=S) if dp > 1 else \
-            make_mesh(devices[:2], stage=2)
-        s0, s1 = ResNet18Stage0(dtype=dtype), ResNet18Stage1(dtype=dtype)
-        p0 = s0.init(jax.random.PRNGKey(0), x[:8])["params"]
-        mid = s0.apply({"params": p0}, x[:8])
-        p1 = s1.init(jax.random.PRNGKey(1), mid)["params"]
-        params = (p0, p1)
-        mb = batch // M // dp
-        step_pp = make_het_pipeline_train_step(
-            [lambda p, h: s0.apply({"params": p}, h),
-             lambda p, h: s1.apply({"params": p}, h)],
-            lambda logits, b: cross_entropy_logits(logits, b["y"]),
-            (mb, 32, 32, 3), [(mb,) + mid.shape[1:], (mb, 10)],
-            tx, mesh, M, data_axis="data" if dp > 1 else None,
-            compute_dtype=dtype,
-        )
-        opt_state = tx.init(params)
-        topo = f"mesh(data={dp}, stage=2), microbatches={M}"
+    # the SAME builder bench.py uses (ddl25spring_tpu/benchmarks.py): raw
+    # uint8 batches in, normalization fused into the jitted step
+    step, params, opt_state, meta = build_resnet_step(
+        devices, dp, S, M, batch, lr=args.lr or 0.1
+    )
 
-        def step(params, opt_state, bat, key):
-            return step_pp(params, opt_state, bat)
-
-        def fixed_batch():
-            return {"x": jnp.asarray(x_host), "y": jnp.asarray(y_host)}
-    else:
-        mesh = make_mesh(devices, data=dp)
-        model = ResNet18(norm="group", dtype=dtype)
-        params = model.init(jax.random.PRNGKey(0), x[:8])["params"]
-
-        def loss_fn(p, bat, key):
-            xb, yb = bat
-            logits = model.apply({"params": p}, xb.astype(dtype), train=True)
-            return cross_entropy_logits(logits, yb)
-
-        step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
-        opt_state = tx.init(params)
-        topo = f"mesh(data={dp})"
-
-        def fixed_batch():
-            return (jnp.asarray(x_host), jnp.asarray(y_host))
-
+    # streaming input: auto-on when CIFAR binaries are present (the fastest
+    # correct path should not hide behind a flag); --stream forces it
+    # (synthesizing CIFAR-format binaries if needed), --no-stream opts out
     stream = None
-    if args.stream:
+    want_stream = args.stream if args.stream is not None \
+        else (_find_loader_dir() is not None)
+    if want_stream:
+        from ddl25spring_tpu.data.cifar10 import ensure_bin_dir
         from ddl25spring_tpu.data.native_loader import (
-            NativeCifar10Loader, NativeLoaderUnavailable, normalize_on_device,
+            NativeCifar10Loader, NativeLoaderUnavailable,
         )
 
-        cdir = os.environ.get("DDL25_CIFAR10_DIR", "data/cifar-10-batches-bin")
         try:
+            cdir, provenance = ensure_bin_dir()
             # raw uint8 over the host->device link (4x less traffic than
-            # fp32); normalization happens device-side
+            # fp32); normalization happens device-side inside the step
             stream = iter(
                 NativeCifar10Loader(cdir, batch_size=batch, normalize=False)
             )
+            print(f"native streaming input: {cdir} ({provenance} data)")
         except NativeLoaderUnavailable as e:
             print(f"native loader unavailable ({e}); using fixed batch")
 
-    batch_pytree = fixed_batch() if stream is None else None
+    if stream is None:
+        d = load_cifar10_u8(n_train=batch)
+        fixed = (jnp.asarray(d["x"]), jnp.asarray(d["y"]))
 
     def feed():
         if stream is None:
-            return batch_pytree
+            return fixed
         xs, ys = next(stream)
-        xd = normalize_on_device(jnp.asarray(xs))
-        if S == 2:
-            return {"x": xd, "y": jnp.asarray(ys)}
-        return (xd, jnp.asarray(ys))
+        return jnp.asarray(xs), jnp.asarray(ys)
 
-    print(f"resnet18/cifar10: {topo}, global batch={batch}, "
+    print(f"resnet18/cifar10: {meta['topology']}, global batch={batch}, "
           f"{n_used}/{n} device(s) in mesh"
           + (", native streaming input" if stream is not None else ""))
-    key = jax.random.PRNGKey(2)
     for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, feed(), key)
+        params, opt_state, loss = step(params, opt_state, feed())
     float(loss)  # force completion (async dispatch)
 
+    import contextlib
+
+    from ddl25spring_tpu.utils.tracing import trace
+
+    ctx = trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     t0 = time.perf_counter()
-    for it in range(iters):
-        params, opt_state, loss = step(params, opt_state, feed(), key)
-        if args.log_every and (it % args.log_every == 0):
-            print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
-    float(loss)
+    with ctx:
+        for it in range(iters):
+            params, opt_state, loss = step(params, opt_state, feed())
+            if args.log_every and (it % args.log_every == 0):
+                print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
+        float(loss)
     dt = time.perf_counter() - t0
     sps_chip = iters * batch / dt / n_used
+
+    from ddl25spring_tpu.utils.flops import chip_peak_flops, compiled_flops, mfu
+
+    fl = compiled_flops(step, params, opt_state, feed())
+    tf, frac = mfu(fl, dt / iters, n_used, devices[0])
+    peak = chip_peak_flops(devices[0])
+    if tf is not None:
+        print(f"achieved {tf:.1f} TFLOP/s/chip"
+              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+    if args.trace_dir:
+        print(f"profiler trace written to {args.trace_dir}")
     print(json.dumps({
-        "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
+        "metric": f"cifar10_resnet18_{meta['layout']}"
+                  "_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps_chip / 5000.0, 3),
+        "input": "native-stream-uint8" if stream is not None
+                 else "fixed-device-batch",
+        "mfu": round(frac, 4) if frac else None,
+        "achieved_tflops_per_chip": round(tf, 1) if tf else None,
     }))
 
 

@@ -15,6 +15,7 @@ import pytest
 from ddl25spring_tpu.models import llama
 from ddl25spring_tpu.ops.losses import causal_lm_loss
 from ddl25spring_tpu.parallel.pipeline import (
+    make_1f1b_value_and_grad,
     make_grad_accum_step,
     make_pipeline_loss,
     make_pipeline_train_step,
@@ -110,6 +111,102 @@ def test_pipeline_train_step_loss_decreases(devices8):
         staged, opt_state, loss = step(staged, opt_state, tokens)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("stages,microbatches,dp", [(2, 3, 1), (4, 2, 1), (2, 4, 2)])
+def test_1f1b_equals_gpipe_and_serial(
+    params_and_tokens, stages, microbatches, dp, devices8
+):
+    """The 1F1B schedule (hand-rolled backward, bounded activation stash)
+    must produce the same loss and gradients as GPipe and the serial model
+    (the reference's 1F1B chain generalized: ``intro_PP_1F1B.py:50-95``)."""
+    params, tokens = params_and_tokens
+    B = 2 * microbatches * dp  # divisible by M, with M-chunks divisible by dp
+    tokens = jnp.tile(tokens, (-(-B // tokens.shape[0]), 1))[:B]
+    devs = devices8[: stages * dp]
+    data_axis = "data" if dp > 1 else None
+    mesh = (
+        make_mesh(devs, data=dp, stage=stages)
+        if dp > 1
+        else make_mesh(devs, stage=stages)
+    )
+    staged = llama.split_blocks_for_stages(params, stages)
+
+    l_1f1b, g_1f1b = jax.jit(
+        make_1f1b_value_and_grad(CFG, mesh, microbatches, data_axis=data_axis)
+    )(staged, tokens)
+    l_gpipe, g_gpipe = jax.jit(
+        jax.value_and_grad(
+            make_pipeline_loss(CFG, mesh, microbatches, data_axis=data_axis)
+        )
+    )(staged, tokens)
+
+    np.testing.assert_allclose(float(l_1f1b), float(l_gpipe), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(l_1f1b), float(serial_loss(params, tokens)), rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-5, rtol=2e-4
+        ),
+        g_gpipe,
+        g_1f1b,
+    )
+    g_serial = jax.grad(serial_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_from_stages(g_1f1b),
+    )
+
+
+def test_1f1b_train_step_loss_decreases(devices8):
+    mesh = make_mesh(devices8[:2], stage=2)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+    staged = shard_staged_params(llama.split_blocks_for_stages(params, 2), mesh)
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(staged)
+    step = make_pipeline_train_step(
+        CFG, tx, mesh, num_microbatches=3, schedule="1f1b"
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (6, 16), 0, 64)
+    losses = []
+    for _ in range(15):
+        staged, opt_state, loss = step(staged, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_1f1b_bounds_activation_memory(devices8):
+    """The point of 1F1B: compiled temp memory is bounded in M.  GPipe's
+    scan-transpose saves every tick's residuals (O(M) activations + block
+    internals); 1F1B stashes only ``2S-1`` stage inputs and rematerializes.
+    At ctx 256 / M=8 the compiled temp footprint must be several times
+    smaller (measured 6.9x at ctx 1024 — RESULTS.md)."""
+    cfg = LlamaConfig(
+        vocab_size=128, dmodel=32, num_heads=2, n_layers=4, ctx_size=256,
+        dtype="float32",
+    )
+    S, M = 2, 8
+    mesh = make_mesh(devices8[:S], stage=S)
+    staged = shard_staged_params(
+        llama.split_blocks_for_stages(
+            llama.init_llama_params(jax.random.PRNGKey(0), cfg), S
+        ),
+        mesh,
+    )
+    tx = optax.adam(1e-3)
+    opt = tx.init(staged)
+    tokens = jnp.zeros((M, cfg.ctx_size), jnp.int32)
+
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        step = make_pipeline_train_step(cfg, tx, mesh, M, schedule=sched)
+        stats = step.lower(staged, opt, tokens).compile().memory_analysis()
+        temps[sched] = stats.temp_size_in_bytes
+    assert temps["1f1b"] * 2 < temps["gpipe"], temps
 
 
 def test_grad_accum_equals_full_batch():
