@@ -32,9 +32,6 @@ import os
 import threading
 
 import jax
-import jax.numpy as jnp
-
-BASELINE_SAMPLES_PER_SEC_PER_CHIP = 5_000.0
 
 
 def probe_devices(timeout_s: float):
@@ -79,11 +76,11 @@ def main(argv=None) -> None:
         }))
         return
 
-    from ddl25spring_tpu.benchmarks import build_resnet_step, timed_run
-    from ddl25spring_tpu.data.cifar10 import ensure_bin_dir, load_cifar10_u8
-    from ddl25spring_tpu.data.native_loader import (
-        NativeCifar10Loader,
-        NativeLoaderUnavailable,
+    from ddl25spring_tpu.benchmarks import (
+        InputFeed,
+        build_resnet_step,
+        report_line,
+        timed_run,
     )
     from ddl25spring_tpu.utils.flops import chip_peak_flops, compiled_flops, mfu
 
@@ -94,73 +91,41 @@ def main(argv=None) -> None:
     step, params, opt_state, meta = build_resnet_step(devices, dp, S, M, batch)
     n_chips = meta["n_chips"]
 
-    # --- input pipelines ---------------------------------------------------
-    loader = stream = None
-    input_mode, provenance = "fixed-device-batch", "synthetic"
-    try:
-        bin_dir, provenance = ensure_bin_dir()
-        loader = NativeCifar10Loader(
-            bin_dir, batch_size=batch, normalize=False,
-            workers=max(2, (os.cpu_count() or 4) // 2), prefetch_depth=6,
-        )
-        stream = iter(loader)
-        input_mode = "native-stream-uint8"
-    except NativeLoaderUnavailable as e:
-        print(f"# native loader unavailable ({e}); primary falls back to fixed batch")
-
-    def feed_stream():
-        xs, ys = next(stream)
-        return jnp.asarray(xs), jnp.asarray(ys)
-
-    if stream is not None:
-        xs, ys = next(stream)  # one stream batch doubles as the fixed batch
-    else:
-        d = load_cifar10_u8(n_train=batch)
-        provenance = d["provenance"]
-        xs, ys = d["x"], d["y"]
-    fixed = (jnp.asarray(xs), jnp.asarray(ys))
-
-    def feed_fixed():
-        return fixed
+    feed = InputFeed(
+        batch, stream=True,
+        workers=max(2, (os.cpu_count() or 4) // 2), prefetch_depth=6,
+    )
 
     # --- timed runs --------------------------------------------------------
-    primary_feed = feed_stream if stream is not None else feed_fixed
     dt, params, opt_state = timed_run(
-        step, params, opt_state, primary_feed, args.steps, args.warmup
+        step, params, opt_state, feed.feed, args.steps, args.warmup
     )
     sps_chip = args.steps * batch / dt / n_chips
 
     dt2, params, opt_state = timed_run(
-        step, params, opt_state, feed_fixed, args.steps, args.warmup
+        step, params, opt_state, feed.feed_fixed, args.steps, args.warmup
     )
     sps_chip_fixed = args.steps * batch / dt2 / n_chips
 
-    flops_step = compiled_flops(step, params, opt_state, fixed)
+    flops_step = compiled_flops(step, params, opt_state, feed.fixed)
     achieved_tf, frac = mfu(flops_step, dt / args.steps, n_chips, meta["device"])
     peak = chip_peak_flops(meta["device"])
 
-    print(json.dumps({
-        "metric": f"cifar10_resnet18_{meta['layout']}_samples_per_sec_per_chip",
-        "value": round(sps_chip, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
-        "input": input_mode,
-        "data": provenance,
-        "topology": meta["topology"],
-        "chip": f"{meta['device'].device_kind} x{n_chips}",
-        "flops_per_step": flops_step,
-        "achieved_tflops_per_chip": round(achieved_tf, 1) if achieved_tf else None,
-        "mfu": round(frac, 4) if frac else None,
-        "peak_tflops_per_chip": peak / 1e12 if peak else None,
-        "secondary": {
+    print(report_line(
+        meta["layout"], sps_chip, feed.input_mode, frac, achieved_tf,
+        data=feed.provenance,
+        topology=meta["topology"],
+        chip=f"{meta['device'].device_kind} x{n_chips}",
+        flops_per_step=flops_step,
+        peak_tflops_per_chip=peak / 1e12 if peak else None,
+        secondary={
             "input": "fixed-device-batch",
             "value": round(sps_chip_fixed, 1),
             "unit": "samples/sec/chip",
         },
-    }))
+    ))
 
-    if loader is not None:
-        loader.close()
+    feed.close()
 
 
 if __name__ == "__main__":

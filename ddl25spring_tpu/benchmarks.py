@@ -31,6 +31,8 @@ from ddl25spring_tpu.parallel.dp import make_dp_train_step
 from ddl25spring_tpu.parallel.het_pipeline import make_het_pipeline_train_step
 from ddl25spring_tpu.utils.mesh import make_mesh
 
+BASELINE_SAMPLES_PER_SEC_PER_CHIP = 5_000.0
+
 
 def build_resnet_step(
     devices: list,
@@ -118,6 +120,95 @@ def build_resnet_step(
         "mesh": mesh,
     }
     return step, params, opt_state, meta
+
+
+class InputFeed:
+    """The benchmark input pipeline, shared by ``bench.py`` and the lab
+    driver: native C++ streaming of raw uint8 batches when enabled, with a
+    fixed device-resident batch as the fallback/secondary mode.
+
+    ``stream``: ``True`` forces streaming (synthesizing CIFAR-format
+    binaries when none exist), ``False`` disables, ``None`` auto-enables
+    when binaries are present.  ``feed()`` yields the primary mode's batch;
+    ``feed_fixed()`` always yields the fixed batch.
+    """
+
+    def __init__(
+        self,
+        batch: int,
+        stream: bool | None = None,
+        workers: int = 2,
+        prefetch_depth: int = 4,
+    ):
+        from ddl25spring_tpu.data.cifar10 import (
+            _find_loader_dir,
+            ensure_bin_dir,
+            load_cifar10_u8,
+        )
+        from ddl25spring_tpu.data.native_loader import (
+            NativeCifar10Loader,
+            NativeLoaderUnavailable,
+        )
+
+        self.loader = self._stream = None
+        self.input_mode, self.provenance = "fixed-device-batch", "synthetic"
+        want = stream if stream is not None else (_find_loader_dir() is not None)
+        if want:
+            try:
+                bin_dir, self.provenance = ensure_bin_dir()
+                self.loader = NativeCifar10Loader(
+                    bin_dir, batch_size=batch, normalize=False,
+                    workers=workers, prefetch_depth=prefetch_depth,
+                )
+                self._stream = iter(self.loader)
+                self.input_mode = "native-stream-uint8"
+                print(f"native streaming input: {bin_dir} "
+                      f"({self.provenance} data)")
+            except NativeLoaderUnavailable as e:
+                print(f"native loader unavailable ({e}); using fixed batch")
+
+        if self._stream is not None:
+            xs, ys = next(self._stream)  # doubles as the fixed batch
+        else:
+            d = load_cifar10_u8(n_train=batch)
+            self.provenance = d["provenance"]
+            xs, ys = d["x"], d["y"]
+        self.fixed = (jnp.asarray(xs), jnp.asarray(ys))
+
+    @property
+    def streaming(self) -> bool:
+        return self._stream is not None
+
+    def feed(self):
+        if self._stream is None:
+            return self.fixed
+        xs, ys = next(self._stream)
+        return jnp.asarray(xs), jnp.asarray(ys)
+
+    def feed_fixed(self):
+        return self.fixed
+
+    def close(self):
+        if self.loader is not None:
+            self.loader.close()
+            self.loader = None
+
+
+def report_line(layout, sps_chip, input_mode, frac, tf, **extra):
+    """The one-line JSON record both drivers print (driver contract:
+    metric/value/unit/vs_baseline, plus self-describing fields)."""
+    import json
+
+    return json.dumps({
+        "metric": f"cifar10_resnet18_{layout}_samples_per_sec_per_chip",
+        "value": round(sps_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps_chip / BASELINE_SAMPLES_PER_SEC_PER_CHIP, 3),
+        "input": input_mode,
+        "mfu": round(frac, 4) if frac else None,
+        "achieved_tflops_per_chip": round(tf, 1) if tf else None,
+        **extra,
+    })
 
 
 def timed_run(step, params, opt_state, feed, steps: int, warmup: int):

@@ -20,16 +20,17 @@ MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 
-def _find_candidate(*markers: str) -> Path | None:
-    """First candidate dir (env var, then standard paths) containing at
-    least one of ``markers``."""
+def _find_candidate(*marker_groups: tuple[str, ...]) -> Path | None:
+    """First candidate dir (env var, then standard paths) satisfying any
+    marker group (a group matches when ALL its files exist)."""
     for cand in (
         os.environ.get("DDL25_CIFAR10_DIR"),
         "data/cifar-10-batches-bin",
         "data/cifar10",
     ):
         if cand and Path(cand).exists() and any(
-            (Path(cand) / m).exists() for m in markers
+            all((Path(cand) / m).exists() for m in group)
+            for group in marker_groups
         ):
             return Path(cand)
     return None
@@ -38,17 +39,14 @@ def _find_candidate(*markers: str) -> Path | None:
 def _find_dir() -> Path | None:
     """Directory with the full canonical layout (train batches + test split)
     — what :func:`load_cifar10` needs."""
-    d = _find_candidate("data_batch_1.bin")
-    if d is not None and (d / "test_batch.bin").exists():
-        return d
-    return None
+    return _find_candidate(("data_batch_1.bin", "test_batch.bin"))
 
 
 def _find_loader_dir() -> Path | None:
     """Directory usable by the native streaming loader — unlike
     :func:`_find_dir` this accepts the single-file ``train.bin`` layout and
     does not require a test split (``native/dataloader.cc`` supports both)."""
-    return _find_candidate("data_batch_1.bin", "train.bin")
+    return _find_candidate(("data_batch_1.bin",), ("train.bin",))
 
 
 def _read_bin_u8(path: Path) -> tuple[np.ndarray, np.ndarray]:

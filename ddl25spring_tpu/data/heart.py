@@ -120,7 +120,13 @@ def load_heart(
     x = np.concatenate(cols, axis=1).astype(np.float32)
     y = raw["target"].astype(np.int32)
     return _freeze(
-        {"x": x, "y": y, "feature_names": names, "feature_slices": slices}
+        {
+            "x": x,
+            "y": y,
+            "feature_names": names,
+            "feature_slices": slices,
+            "provenance": "real" if p is not None else "synthetic",
+        }
     )
 
 

@@ -1,0 +1,32 @@
+"""Platform selection for launchers and examples.
+
+This image registers a TPU ("axon") PJRT plugin at interpreter start via
+sitecustomize, so the ``JAX_PLATFORMS`` env var alone cannot select CPU —
+the choice must go through ``jax.config`` before the first backend
+initialization (see ``tests/conftest.py``).  Every runnable script exposes
+``--force-cpu-devices N`` and calls this helper: the SPMD analogue of the
+reference's gloo-on-localhost fake cluster (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int) -> None:
+    """Simulate an ``n``-device CPU mesh (no-op when ``n`` is 0/None).
+
+    Must run before the first JAX backend init: XLA reads
+    ``xla_force_host_platform_device_count`` when the CPU client starts.
+    """
+    if not n:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -29,13 +29,30 @@ def main(argv=None):
     ap.add_argument("--fraction", type=float, default=0.1)
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--seed", type=int, default=10)  # homework-mandated seed
+    ap.add_argument("--n-train", type=int, default=0,
+                    help="subsample the train set (0 = full 60k); the "
+                         "equivalence holds at any size")
+    ap.add_argument("--force-cpu-devices", type=int, default=0,
+                    metavar="N", help="simulate an N-device CPU mesh")
     args = ap.parse_args(argv)
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
+
+    data = None
+    if args.n_train:
+        from ddl25spring_tpu.data.mnist import load_mnist
+
+        data = load_mnist(n_train=args.n_train, n_test=2000)
+        print(f"# reduced dataset: n_train={args.n_train}, n_test=2000")
 
     common = dict(
         nr_clients=args.clients,
         client_fraction=args.fraction,
         lr=args.lr,
         seed=args.seed,
+        data=data,
     )
     # scenario per series01.ipynb cell 12: weights variant = FedAvg with
     # batch_size=len(data) (B=-1) and E=1

@@ -24,9 +24,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from ddl25spring_tpu.fl import FedAvgServer, FedSgdGradientServer  # noqa: E402
 
+DATA = None  # optional reduced dataset shared across runs (--n-train)
+
 
 def run_one(server_cls, rounds: int, **kw):
-    server = server_cls(**kw)
+    server = server_cls(data=DATA, **kw)
     res = server.run(rounds)
     return res
 
@@ -37,7 +39,7 @@ def sweep_a2(rounds: int, ns, cs, lr: float, seed: int):
         for n in ns:
             res = run_one(
                 cls, rounds, nr_clients=n, client_fraction=0.1,
-                batch_size=-1 if cls is FedSgdGradientServer else 64,
+                batch_size=-1 if cls is FedSgdGradientServer else 100,
                 nr_local_epochs=1, lr=lr, seed=seed,
             )
             print(f"N={n:>4}: final acc {res.test_accuracy[-1]:.4f}  "
@@ -46,7 +48,7 @@ def sweep_a2(rounds: int, ns, cs, lr: float, seed: int):
         for c in cs:
             res = run_one(
                 cls, rounds, nr_clients=ns[-1], client_fraction=c,
-                batch_size=-1 if cls is FedSgdGradientServer else 64,
+                batch_size=-1 if cls is FedSgdGradientServer else 100,
                 nr_local_epochs=1, lr=lr, seed=seed,
             )
             print(f"C={c:>5}: final acc {res.test_accuracy[-1]:.4f}  "
@@ -59,7 +61,7 @@ def sweep_a3(rounds: int, es, lr: float, seed: int):
         for e in es:
             res = run_one(
                 FedAvgServer, rounds, nr_clients=10, client_fraction=0.1,
-                batch_size=64, nr_local_epochs=e, lr=lr, seed=seed, iid=iid,
+                batch_size=100, nr_local_epochs=e, lr=lr, seed=seed, iid=iid,
             )
             print(f"iid={str(iid):>5} E={e:>2}: "
                   f"final acc {res.test_accuracy[-1]:.4f}")
@@ -72,7 +74,28 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=10)
     ap.add_argument("--quick", action="store_true",
                     help="small grid for a fast smoke run")
+    ap.add_argument("--n-train", type=int, default=0,
+                    help="subsample the train set (0 = full 60k).  CPU-mesh "
+                         "runs of the full grid need this; accuracies shift "
+                         "accordingly — state it when recording results")
+    ap.add_argument("--n-test", type=int, default=0)
+    ap.add_argument("--force-cpu-devices", type=int, default=0,
+                    metavar="N", help="simulate an N-device CPU mesh")
     args = ap.parse_args(argv)
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
+
+    if args.n_train:
+        from ddl25spring_tpu.data.mnist import load_mnist
+
+        global DATA
+        DATA = load_mnist(
+            n_train=args.n_train, n_test=args.n_test or 2000
+        )
+        print(f"# reduced dataset: n_train={args.n_train}, "
+              f"n_test={args.n_test or 2000}")
 
     if args.quick:
         ns, cs, es, rounds = [10, 50], [0.1, 0.2], [1, 5], min(args.rounds, 3)

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Tutorial 1b primer — centralized LLaMA training, TPU-native.
+
+The reference primer (``lab/tutorial_1b/primer/intro.py:23-33``) is the
+minimal train loop: ``next(iter_ds) -> net(x) -> causalLLMLoss -> backward
+-> Adam.step`` on one device.  Here that is one jitted step from
+:func:`ddl25spring_tpu.parallel.dp.make_train_step` over the in-tree LLaMA
+at the workload constants (dmodel=288, 6 heads, 6 layers, ctx 256 —
+``lab/s01_b1_microbatches.py:21-24``).
+
+Run: ``python examples/tutorial_1b/intro_primer.py [--force-cpu-devices 1]``
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=3)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=8e-4)
+    ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N")
+    args = ap.parse_args(argv)
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddl25spring_tpu.data.tinystories import TinyStories
+    from ddl25spring_tpu.data.tokenizer import get_tokenizer
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+    from ddl25spring_tpu.parallel.dp import make_train_step
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    tok = get_tokenizer()
+    cfg = LlamaConfig(
+        vocab_size=tok.vocab_size, dmodel=288, num_heads=6, n_layers=6,
+        ctx_size=args.seq_len,
+        dtype="bfloat16" if jax.devices()[0].platform == "tpu" else "float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, tokens, key):
+        return causal_lm_loss(llama.llama_forward(p, tokens, cfg), tokens)
+
+    step = make_train_step(loss_fn, tx)
+    ds = iter(TinyStories(tok, batch_size=args.batch, seq_l=args.seq_len))
+    for it in range(args.iters):
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(next(ds)), jax.random.PRNGKey(it)
+        )
+        print(f"iter {it:3d}  loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,13 @@ def main(argv=None):
     ap.add_argument("--parties", type=int, default=4)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--seed", type=int, default=42)  # vfl.py:106
+    ap.add_argument("--force-cpu-devices", type=int, default=0,
+                    metavar="N", help="simulate an N-device CPU mesh")
     args = ap.parse_args(argv)
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
 
     data = load_heart(seed=args.seed)
     x, y = data["x"], data["y"]

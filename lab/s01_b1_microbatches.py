@@ -106,7 +106,8 @@ def main(argv=None) -> None:
 
     ds = iter(TinyStories(tokenizer, batch_size=args.batch, seq_l=args.seq_len))
     # warmup outside the timer: jit compile dominates the first step
-    staged, opt_state, loss = step(staged, opt_state, jnp.asarray(next(ds)))
+    tokens = jnp.asarray(next(ds))
+    staged, opt_state, loss = step(staged, opt_state, tokens)
     float(loss)
 
     import contextlib

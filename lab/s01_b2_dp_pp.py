@@ -24,7 +24,6 @@ Two workloads:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -195,14 +194,14 @@ def run_llama(args, jax, jnp):
 
 
 def run_resnet(args, jax, jnp):
-    from ddl25spring_tpu.benchmarks import build_resnet_step
-    from ddl25spring_tpu.data.cifar10 import _find_loader_dir, load_cifar10_u8
+    from ddl25spring_tpu.benchmarks import (
+        InputFeed, build_resnet_step, report_line,
+    )
 
     devices = jax.devices()
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
     iters = args.iters or 30
-    warmup = 3
 
     if args.pp and n >= 2:
         dp, S = n // 2, 2
@@ -217,88 +216,48 @@ def run_resnet(args, jax, jnp):
     batch = args.batch or (1024 if on_tpu else 4) * n_used
     batch = batch // (dp * M) * (dp * M)
 
-    # the SAME builder bench.py uses (ddl25spring_tpu/benchmarks.py): raw
-    # uint8 batches in, normalization fused into the jitted step
+    # the SAME builder + input pipeline bench.py uses (benchmarks.py): raw
+    # uint8 batches in, normalization fused into the jitted step; streaming
+    # auto-on when CIFAR binaries exist, --stream forces, --no-stream opts out
     step, params, opt_state, meta = build_resnet_step(
         devices, dp, S, M, batch, lr=args.lr or 0.1
     )
-
-    # streaming input: auto-on when CIFAR binaries are present (the fastest
-    # correct path should not hide behind a flag); --stream forces it
-    # (synthesizing CIFAR-format binaries if needed), --no-stream opts out
-    stream = None
-    want_stream = args.stream if args.stream is not None \
-        else (_find_loader_dir() is not None)
-    if want_stream:
-        from ddl25spring_tpu.data.cifar10 import ensure_bin_dir
-        from ddl25spring_tpu.data.native_loader import (
-            NativeCifar10Loader, NativeLoaderUnavailable,
-        )
-
-        try:
-            cdir, provenance = ensure_bin_dir()
-            # raw uint8 over the host->device link (4x less traffic than
-            # fp32); normalization happens device-side inside the step
-            stream = iter(
-                NativeCifar10Loader(cdir, batch_size=batch, normalize=False)
-            )
-            print(f"native streaming input: {cdir} ({provenance} data)")
-        except NativeLoaderUnavailable as e:
-            print(f"native loader unavailable ({e}); using fixed batch")
-
-    if stream is None:
-        d = load_cifar10_u8(n_train=batch)
-        fixed = (jnp.asarray(d["x"]), jnp.asarray(d["y"]))
-
-    def feed():
-        if stream is None:
-            return fixed
-        xs, ys = next(stream)
-        return jnp.asarray(xs), jnp.asarray(ys)
+    feed = InputFeed(batch, stream=args.stream)
 
     print(f"resnet18/cifar10: {meta['topology']}, global batch={batch}, "
           f"{n_used}/{n} device(s) in mesh"
-          + (", native streaming input" if stream is not None else ""))
-    for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, feed())
-    float(loss)  # force completion (async dispatch)
+          + (", native streaming input" if feed.streaming else ""))
 
     import contextlib
 
     from ddl25spring_tpu.utils.tracing import trace
 
+    # warmup (compile) happens inside timed_run; wrap the timed loop only
     ctx = trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
-    t0 = time.perf_counter()
     with ctx:
+        for _ in range(3):  # warmup / compile
+            params, opt_state, loss = step(params, opt_state, feed.feed())
+        float(loss)
+        t0 = time.perf_counter()
         for it in range(iters):
-            params, opt_state, loss = step(params, opt_state, feed())
+            params, opt_state, loss = step(params, opt_state, feed.feed())
             if args.log_every and (it % args.log_every == 0):
                 print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
         float(loss)
-    dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0
     sps_chip = iters * batch / dt / n_used
 
-    from ddl25spring_tpu.utils.flops import chip_peak_flops, compiled_flops, mfu
+    from ddl25spring_tpu.utils.flops import compiled_flops, mfu
 
-    fl = compiled_flops(step, params, opt_state, feed())
+    fl = compiled_flops(step, params, opt_state, feed.fixed)
     tf, frac = mfu(fl, dt / iters, n_used, devices[0])
-    peak = chip_peak_flops(devices[0])
     if tf is not None:
         print(f"achieved {tf:.1f} TFLOP/s/chip"
               + (f" (MFU {frac:.1%})" if frac is not None else ""))
     if args.trace_dir:
         print(f"profiler trace written to {args.trace_dir}")
-    print(json.dumps({
-        "metric": f"cifar10_resnet18_{meta['layout']}"
-                  "_samples_per_sec_per_chip",
-        "value": round(sps_chip, 1),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps_chip / 5000.0, 3),
-        "input": "native-stream-uint8" if stream is not None
-                 else "fixed-device-batch",
-        "mfu": round(frac, 4) if frac else None,
-        "achieved_tflops_per_chip": round(tf, 1) if tf else None,
-    }))
+    print(report_line(meta["layout"], sps_chip, feed.input_mode, frac, tf))
+    feed.close()
 
 
 def main(argv=None) -> None:
