@@ -58,6 +58,8 @@ def main(argv=None) -> None:
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (local testing; the axon TPU "
                          "plugin is registered at interpreter start)")
+    ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
+                    help="simulate an N-device CPU mesh (implies --cpu)")
     ap.add_argument("--per-chip-batch", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
@@ -65,7 +67,11 @@ def main(argv=None) -> None:
     ap.add_argument("--probe-timeout", type=float, default=240.0)
     args = ap.parse_args(argv)
 
-    if args.cpu:
+    if args.force_cpu_devices:
+        from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+        force_cpu_devices(args.force_cpu_devices)
+    elif args.cpu:
         jax.config.update("jax_platforms", "cpu")
     devices, err = probe_devices(args.probe_timeout)
     if devices is None:

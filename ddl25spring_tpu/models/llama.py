@@ -150,9 +150,20 @@ def block_forward(
 
     if attn_fn is None:
         if cfg.use_flash:
-            from ddl25spring_tpu.ops.flash_attention import flash_attention
 
-            attn_fn = lambda q, k, v, dtype: flash_attention(q, k, v)
+            def attn_fn(q, k, v, dtype):
+                from ddl25spring_tpu.ops.flash_attention import flash_attention
+
+                # Off-TPU the kernel runs in Pallas interpret mode, which
+                # cannot execute inside shard_map under JAX 0.9's VMA
+                # checking (interpret lowering mixes varying data with
+                # invariant block indices).  Detect that context — varying
+                # mesh axes on the operand + non-TPU backend — and use the
+                # dense path there; flash stays the default on TPU.
+                in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
+                if in_shard_map and jax.default_backend() != "tpu":
+                    return causal_attention(q, k, v, dtype)
+                return flash_attention(q, k, v)
         else:
             attn_fn = causal_attention
     attn = attn_fn(q, k, v, dtype)

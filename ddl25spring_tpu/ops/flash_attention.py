@@ -32,6 +32,21 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _sds(shape, dtype, *refs):
+    """``ShapeDtypeStruct`` carrying the union of ``refs``' varying mesh
+    axes (vma).  Under ``shard_map`` with VMA checking (JAX 0.9 default),
+    ``pallas_call`` out_shapes must state how outputs vary across mesh axes
+    — without this the kernel cannot be used inside the pipeline/DP
+    shard_maps.  Outside shard_map every vma is empty and this degrades to
+    a plain ShapeDtypeStruct."""
+    vma: frozenset = frozenset()
+    for r in refs:
+        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pos(base: int, n: int):
     # TPU needs >= 2-D iota; broadcasted_iota then squeeze
     return base + jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)[:, 0]
@@ -107,8 +122,8 @@ def _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret):
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q3.shape, q3.dtype),
-            jax.ShapeDtypeStruct((BH, L, 1), jnp.float32),
+            _sds(q3.shape, q3.dtype, q3, k3, v3),
+            _sds((BH, L, 1), jnp.float32, q3, k3, v3),
         ],
         interpret=interpret,
     )(q3, k3, v3)
@@ -268,7 +283,7 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        out_shape=_sds(q3.shape, q3.dtype, q3, k3, v3, do),
         interpret=interpret,
     )(q3, k3, v3, do, lse, delta)
 
@@ -290,8 +305,8 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
             pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k3.shape, k3.dtype),
-            jax.ShapeDtypeStruct(v3.shape, v3.dtype),
+            _sds(k3.shape, k3.dtype, q3, k3, v3, do),
+            _sds(v3.shape, v3.dtype, q3, k3, v3, do),
         ],
         interpret=interpret,
     )(q3, k3, v3, do, lse, delta)

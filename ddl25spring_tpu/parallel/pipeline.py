@@ -63,6 +63,7 @@ def make_pipeline_loss(
     num_microbatches: int,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    remat: bool = False,
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
 
@@ -72,6 +73,12 @@ def make_pipeline_loss(
     ``B = num_microbatches * microbatch_size`` (times the data-axis size
     when ``data_axis`` is given — the global batch, like the reference's
     disjoint per-pipeline streams at ``s01_b2_dp_pp.py:60,78``).
+
+    ``remat=True`` wraps each tick in ``jax.checkpoint``: the scan saves
+    only per-tick carries ([mb, L, d] activations) and recomputes block
+    internals in the backward — a middle point between plain GPipe (all
+    residuals live) and the 1F1B schedule (M-invariant stash,
+    :func:`make_1f1b_value_and_grad`).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
@@ -135,7 +142,8 @@ def make_pipeline_loss(
             lax.pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
             lax.pcast(jnp.float32(0.0), axes, to="varying"),
         )
-        (_, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
+        tick_fn = jax.checkpoint(tick) if remat else tick
+        (_, loss_sum), _ = lax.scan(tick_fn, carry0, jnp.arange(M + S - 1))
 
         total = lax.psum(loss_sum, stage_axis) / M
         if data_axis is not None:
@@ -391,6 +399,33 @@ def make_pipeline_train_step(
         return params, opt_state, loss
 
     return step
+
+
+def warmup_with_flash_fallback(cfg, build_step, step, *step_args):
+    """Run the first (compiling) call of ``step``; if it raises while the
+    Pallas flash kernel is enabled, rebuild via ``build_step(dense_cfg)``
+    and retry once — so a kernel that cannot lower on this backend degrades
+    to dense attention instead of killing the run.
+
+    The retry is deliberately broad (Pallas lowering failures have no
+    stable exception type across JAX versions): if the failure was NOT
+    flash's fault the dense retry re-raises the same error, costing one
+    extra compile attempt but never masking it.  Returns
+    ``(first_step_output, step, cfg)`` with whichever configuration
+    succeeded.
+    """
+    try:
+        return step(*step_args), step, cfg
+    except Exception as e:  # noqa: BLE001 — see docstring
+        if not cfg.use_flash:
+            raise
+        print(f"first step failed ({type(e).__name__}); retrying with dense "
+              "attention in case the Pallas flash kernel is at fault")
+        from ddl25spring_tpu.utils.config import replace
+
+        cfg = replace(cfg, use_flash=False)
+        step = build_step(cfg)
+        return step(*step_args), step, cfg
 
 
 def shard_staged_params(params: Params, mesh: Mesh, stage_axis: str = "stage"):

@@ -11,6 +11,10 @@ reference's gloo-on-localhost fake cluster (SURVEY §4).
 from __future__ import annotations
 
 import os
+import re
+import warnings
+
+_FLAG = "--xla_force_host_platform_device_count"
 
 
 def force_cpu_devices(n: int) -> None:
@@ -18,14 +22,22 @@ def force_cpu_devices(n: int) -> None:
 
     Must run before the first JAX backend init: XLA reads
     ``xla_force_host_platform_device_count`` when the CPU client starts.
+    An existing count in ``XLA_FLAGS`` that disagrees with ``n`` is
+    overridden with a warning (the explicit argument wins).
     """
     if not n:
         return
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    m = re.search(rf"{_FLAG}=(\d+)", flags)
+    if m and int(m.group(1)) != n:
+        warnings.warn(
+            f"XLA_FLAGS already sets {_FLAG}={m.group(1)}; overriding with "
+            f"the requested {n}"
+        )
+        flags = re.sub(rf"{_FLAG}=\d+", f"{_FLAG}={n}", flags)
+        os.environ["XLA_FLAGS"] = flags
+    elif not m:
+        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n}").strip()
 
     import jax
 

@@ -53,18 +53,15 @@ def parse_args(argv=None):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
-    if args.force_cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
-        ).strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
+
     import jax
     import jax.numpy as jnp
     import optax
-
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     from ddl25spring_tpu.data.tinystories import TinyStories
     from ddl25spring_tpu.data.tokenizer import get_tokenizer
     from ddl25spring_tpu.models import llama
@@ -106,8 +103,16 @@ def main(argv=None) -> None:
 
     ds = iter(TinyStories(tokenizer, batch_size=args.batch, seq_l=args.seq_len))
     # warmup outside the timer: jit compile dominates the first step
+    from ddl25spring_tpu.parallel.pipeline import warmup_with_flash_fallback
+
     tokens = jnp.asarray(next(ds))
-    staged, opt_state, loss = step(staged, opt_state, tokens)
+    (staged, opt_state, loss), step, cfg = warmup_with_flash_fallback(
+        cfg,
+        lambda c: make_pipeline_train_step(
+            c, tx, mesh, args.microbatches, schedule=args.schedule
+        ),
+        step, staged, opt_state, tokens,
+    )
     float(loss)
 
     import contextlib

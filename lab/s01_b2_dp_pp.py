@@ -146,8 +146,17 @@ def run_llama(args, jax, jnp):
     # outputs are DISCARDED — a warmup that stepped the optimizer would give
     # every resumed run one extra update and break kill-and-resume
     # equivalence with an uninterrupted run
+    from ddl25spring_tpu.parallel.pipeline import warmup_with_flash_fallback
+
     tokens_w = jnp.asarray(next(ds))
-    _ = step(staged, opt_state, tokens_w)
+    _, step, cfg = warmup_with_flash_fallback(
+        cfg,
+        lambda c: make_pipeline_train_step(
+            c, tx, mesh, M, data_axis="data" if dp > 1 else None,
+            schedule=args.schedule,
+        ),
+        step, staged, opt_state, tokens_w,
+    )
     float(_[2])
 
     import contextlib
@@ -262,17 +271,14 @@ def run_resnet(args, jax, jnp):
 
 def main(argv=None) -> None:
     args = parse_args(argv)
-    if args.force_cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.force_cpu_devices}"
-        ).strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.force_cpu_devices)
+
     import jax
     import jax.numpy as jnp
-
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     if args.workload == "llama":
         run_llama(args, jax, jnp)
     else:

@@ -1,0 +1,83 @@
+"""Unit tests: FLOPs/MFU accounting and the notebook scrubber."""
+
+import json
+
+import jax
+import numpy as np
+
+from ddl25spring_tpu.utils.flops import chip_peak_flops, compiled_flops, mfu
+
+
+def test_compiled_flops_counts_matmul():
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a, b):
+        return (a @ b).sum()
+
+    a = jnp.ones((128, 128))
+    fl = compiled_flops(f, a, a)
+    # 2*n^3 MACs-as-flops, plus the reduction; cost model may round
+    assert fl is not None and fl >= 2 * 128**3
+
+
+def test_chip_peak_prefix_match_prefers_longest():
+    # device_kind "TPU v5 lite" must hit the v5e entry (197e12), not the
+    # "TPU v5" (v5p) prefix
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    assert chip_peak_flops(FakeDev()) == 197e12
+
+    class FakeV5p:
+        platform = "tpu"
+        device_kind = "TPU v5"
+
+    assert chip_peak_flops(FakeV5p()) == 459e12
+
+
+def test_chip_peak_none_on_cpu():
+    assert chip_peak_flops(jax.devices("cpu")[0]) is None
+
+
+def test_mfu_math():
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v4"
+
+    tf, frac = mfu(275e12, 1.0, n_chips=1, device=FakeDev())
+    assert tf == 275.0
+    np.testing.assert_allclose(frac, 1.0)
+    assert mfu(None, 1.0) == (None, None)
+
+
+def test_notebook_scrubber(tmp_path):
+    import subprocess
+    import sys
+
+    nb = {
+        "metadata": {"kernelspec": {"name": "python3"}, "widgets": {"x": 1}},
+        "nbformat": 4, "nbformat_minor": 5,
+        "cells": [{
+            "cell_type": "code", "source": ["1+1"],
+            "execution_count": 3, "metadata": {"scrolled": True},
+            "outputs": [{"output_type": "execute_result", "data": {}}],
+        }],
+    }
+    from pathlib import Path
+
+    tool = Path(__file__).resolve().parent.parent / "tools/clear_notebook_metadata.py"
+    p = tmp_path / "x.ipynb"
+    p.write_text(json.dumps(nb))
+    r = subprocess.run(
+        [sys.executable, str(tool), str(tmp_path)],
+        capture_output=True, text=True, check=True,
+    )
+    assert "1 notebook(s) changed" in r.stdout
+    out = json.loads(p.read_text())
+    cell = out["cells"][0]
+    assert cell["outputs"] == [] and cell["execution_count"] is None
+    assert cell["metadata"] == {}
+    assert "widgets" not in out["metadata"]
+    assert "kernelspec" in out["metadata"]

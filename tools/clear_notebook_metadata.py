@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Notebook scrubber: clear outputs, execution counts, and volatile metadata
+from every ``*.ipynb`` under the given directories (default: repo root).
+
+Parity: the reference ships ``lab/clear-metadata-notebooks.py`` (nbconvert
+``ClearOutputPreprocessor`` + ``ClearMetadataPreprocessor`` over ``lab/``,
+``clear-metadata-notebooks.py:10-22``).  This version is dependency-free —
+plain JSON rewriting — because notebooks are an interchange artifact here,
+not a dev dependency: the homework "notebooks" ship as runnable scripts in
+``examples/`` (see ``examples/README.md``), and any notebook a user adds
+gets scrubbed the same way before commit.
+
+Usage: ``python tools/clear_notebook_metadata.py [dir ...]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+KEEP_METADATA = {"kernelspec", "language_info"}
+
+
+def scrub(path: Path) -> bool:
+    nb = json.loads(path.read_text())
+    changed = False
+    if set(nb.get("metadata", {})) - KEEP_METADATA:
+        nb["metadata"] = {
+            k: v for k, v in nb["metadata"].items() if k in KEEP_METADATA
+        }
+        changed = True
+    for cell in nb.get("cells", []):
+        if cell.get("outputs") or cell.get("execution_count") is not None:
+            cell["outputs"] = []
+            cell["execution_count"] = None
+            changed = True
+        if cell.get("metadata"):
+            cell["metadata"] = {}
+            changed = True
+    if changed:
+        path.write_text(json.dumps(nb, indent=1, ensure_ascii=False) + "\n")
+    return changed
+
+
+def main(argv: list[str]) -> int:
+    roots = [Path(a) for a in argv] or [Path(".")]
+    n = 0
+    for root in roots:
+        for p in sorted(root.rglob("*.ipynb")):
+            if ".ipynb_checkpoints" in p.parts:
+                continue
+            if scrub(p):
+                print(f"scrubbed {p}")
+                n += 1
+    print(f"{n} notebook(s) changed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
