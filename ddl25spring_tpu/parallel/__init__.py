@@ -3,9 +3,19 @@ from ddl25spring_tpu.parallel.dp import (
     make_dp_weight_avg_step,
     make_train_step,
 )
+from ddl25spring_tpu.parallel.ep import (
+    init_moe_params,
+    make_ep_moe_fn,
+    moe_ffn,
+    shard_moe_params,
+)
 
 __all__ = [
     "make_dp_train_step",
     "make_dp_weight_avg_step",
     "make_train_step",
+    "init_moe_params",
+    "make_ep_moe_fn",
+    "moe_ffn",
+    "shard_moe_params",
 ]

@@ -1,0 +1,177 @@
+"""Expert parallelism: a switch-style MoE FFN over a mesh ``expert`` axis.
+
+The reference has no MoE (SURVEY §2: EP absent) — this is a beyond-parity
+capability completing the framework's parallelism axis set (dp/pp/tp/sp/ep).
+Design is TPU-first throughout:
+
+- top-1 (switch) routing with a **capacity-bucketed dense dispatch**: the
+  ragged token->expert assignment becomes one-hot ``[T, E, C]`` dispatch/
+  combine tensors so everything is static-shaped einsums on the MXU — no
+  gather/scatter, no dynamic shapes (the Mesh-TensorFlow/Switch formulation);
+- tokens over capacity are dropped (their residual stream passes through
+  untouched), the standard switch behavior;
+- experts are bias-free SwiGLU blocks stacked ``[E, ...]``; under EP the
+  stack is sharded over the ``expert`` axis and tokens are sharded over the
+  same axis, with two ``lax.all_to_all`` hops (dispatch out, combine back)
+  riding ICI — the TPU-native equivalent of NCCL all-to-all in GPU MoE
+  stacks;
+- an auxiliary load-balancing loss (mean fraction x mean router prob per
+  expert, scaled by E) is returned alongside the output.
+
+``moe_ffn`` is the single-device reference; ``make_ep_moe_fn`` returns the
+EP-sharded version.  With ample capacity the two are exactly equal
+(asserted in ``tests/test_ep.py``); under overflow they differ only in
+which tokens drop (per-shard vs global capacity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = dict[str, Any]
+
+
+def init_moe_params(
+    key: jax.Array, dmodel: int, ffn_dim: int, n_experts: int
+) -> Params:
+    """Router ``[D, E]`` + stacked bias-free SwiGLU experts ``[E, ...]``.
+    (Bias-free so a zero capacity-padding row maps to zero — dispatch
+    correctness does not depend on masking expert internals.)"""
+    ks = jax.random.split(key, 4)
+    s = 0.02
+
+    def dense(k, shape):
+        return (s * jax.random.normal(k, shape)).astype(jnp.float32)
+
+    return {
+        "router": dense(ks[0], (dmodel, n_experts)),
+        "w_gate": dense(ks[1], (n_experts, dmodel, ffn_dim)),
+        "w_up": dense(ks[2], (n_experts, dmodel, ffn_dim)),
+        "w_down": dense(ks[3], (n_experts, ffn_dim, dmodel)),
+    }
+
+
+def _expert_ffn(p: Params, x: jax.Array) -> jax.Array:
+    """Apply all experts to their capacity buckets: ``x [E, C, D]`` with the
+    stacked expert weights — one batched einsum per matmul (MXU-friendly),
+    no per-expert Python loop."""
+    dtype = x.dtype
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", x, p["w_gate"].astype(dtype)))
+    up = jnp.einsum("ecd,edf->ecf", x, p["w_up"].astype(dtype))
+    return jnp.einsum("ecf,efd->ecd", gate * up, p["w_down"].astype(dtype))
+
+
+def _dispatch_tensors(router_logits: jax.Array, capacity: int):
+    """Switch dispatch: one-hot ``[T, E, C]`` dispatch mask and gate-weighted
+    combine tensor, plus the load-balancing auxiliary loss."""
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate = jnp.max(probs, axis=-1)                    # [T]
+    expert = jnp.argmax(probs, axis=-1)               # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+    # position of each token within its expert's bucket (arrival order)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # [T, E]
+    keep = onehot * (pos < capacity)                       # overflow drops
+    disp = keep[:, :, None] * jax.nn.one_hot(
+        pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32
+    )[:, None, :]                                          # [T, E, C]
+    combine = disp * gate[:, None, None]
+    # Switch aux loss: E * sum_e fraction_e * mean-prob_e
+    frac = keep.sum(0) / jnp.maximum(onehot.sum(), 1.0)
+    aux = E * jnp.sum(frac * probs.mean(0))
+    return disp, combine, aux
+
+
+def moe_ffn(
+    p: Params, x: jax.Array, capacity_factor: float = 1.25
+) -> tuple[jax.Array, jax.Array]:
+    """Single-device reference MoE: ``x [T, D] -> ([T, D], aux_loss)``."""
+    T, D = x.shape
+    E = p["router"].shape[1]
+    C = max(1, int(T * capacity_factor / E))
+    logits = x.astype(jnp.float32) @ p["router"]
+    disp, combine, aux = _dispatch_tensors(logits, C)
+    expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
+    expert_out = _expert_ffn(p, expert_in)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return y, aux
+
+
+def make_ep_moe_fn(
+    mesh: Mesh,
+    axis: str = "expert",
+    capacity_factor: float = 1.25,
+):
+    """EP-sharded MoE: tokens AND experts sharded over ``mesh[axis]``.
+
+    ``f(params, x)``: ``params`` with expert stacks sharded ``[E, ...]``
+    over the axis (router replicated), ``x [T, D]`` sharded on tokens.
+    Per shard: local dispatch to all E experts -> ``all_to_all`` so each
+    device holds its local experts' buckets from every shard -> batched
+    expert FFN -> ``all_to_all`` back -> local combine.
+    """
+    ep = mesh.shape[axis]
+
+    param_specs = {
+        "router": P(),
+        "w_gate": P(axis),
+        "w_up": P(axis),
+        "w_down": P(axis),
+    }
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P(axis)),
+        out_specs=(P(axis), P()),
+    )
+    def f(p: Params, x: jax.Array):
+        T_local, D = x.shape
+        E = p["router"].shape[1]          # global expert count
+        E_local = E // ep
+        C = max(1, int(T_local * capacity_factor / E))
+        router = lax.pcast(p["router"], axis, to="varying")
+        logits = x.astype(jnp.float32) @ router
+        disp, combine, aux = _dispatch_tensors(logits, C)
+
+        expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
+        # regroup [E, C, D] = [ep, E_local, C, D]: hand shard s's buckets
+        # for expert group g to device g; receive every shard's buckets for
+        # OUR experts (dim0 becomes the source shard)
+        a2a = lax.all_to_all(
+            expert_in.reshape(ep, E_local, C, D), axis, 0, 0, tiled=False
+        )                                  # [ep, E_local, C, D], dim0 = src
+        mine = a2a.transpose(1, 0, 2, 3).reshape(E_local, ep * C, D)
+        # the sharded-in expert stacks are already this device's [E_local,...]
+        out = _expert_ffn(
+            {k: p[k] for k in ("w_gate", "w_up", "w_down")}, mine
+        )
+        back = lax.all_to_all(
+            out.reshape(E_local, ep, C, D).transpose(1, 0, 2, 3), axis, 0, 0,
+            tiled=False,
+        )                                  # [ep, E_local, C, D] -> our tokens
+        expert_out = back.reshape(E, C, D)
+        y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+        # aux is the mean of per-shard switch losses (each over its token
+        # shard) — the standard sharded-MoE estimator; it converges to the
+        # global loss but is not bitwise equal to it (product of means !=
+        # mean of products)
+        return y, lax.pmean(aux, axis)
+
+    return f
+
+
+def shard_moe_params(p: Params, mesh: Mesh, axis: str = "expert") -> Params:
+    """Place the expert stacks sharded over ``axis``, router replicated."""
+    return jax.device_put(p, {
+        "router": NamedSharding(mesh, P()),
+        "w_gate": NamedSharding(mesh, P(axis)),
+        "w_up": NamedSharding(mesh, P(axis)),
+        "w_down": NamedSharding(mesh, P(axis)),
+    })

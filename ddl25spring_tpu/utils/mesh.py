@@ -69,6 +69,61 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def make_hybrid_mesh(
+    dcn_axes: dict[str, int] | None = None, **ici_axes: int
+) -> Mesh:
+    """Multi-host mesh: ``dcn_axes`` laid over the slow inter-slice network,
+    ``ici_axes`` over the fast in-slice interconnect.
+
+    The reference's multi-node story is gloo over TCP with NCCL recommended
+    for production (``tutorial_1b/README.md:71``); the TPU-native analogue
+    is a hybrid mesh where XLA routes collectives for the outer axes over
+    DCN and everything else over ICI.  Granularity is the ICI **slice**
+    (which may span multiple hosts/processes), per
+    ``mesh_utils.create_hybrid_device_mesh``.  Usage (standard recipe: put
+    DP — the least communication-intensive axis — on DCN):
+
+        jax.distributed.initialize()          # one process per host
+        mesh = make_hybrid_mesh({"data": n_slices}, stage=4, model=2)
+
+    Falls back to a flat :func:`make_mesh` in single-process settings (CPU
+    simulation / one host) where there is no slice structure to respect.
+    """
+    dcn_axes = dict(dcn_axes or {})
+    if jax.process_count() == 1:
+        return make_mesh(None, **dcn_axes, **ici_axes)
+    from jax.experimental import mesh_utils
+
+    # DCN granularity is the ICI slice — possibly several hosts — not the
+    # process; fall back to process count where the backend exposes no
+    # slice_index (CPU simulation)
+    slice_ids = {getattr(d, "slice_index", None) for d in jax.devices()}
+    n_slices = (
+        jax.process_count() if None in slice_ids else len(slice_ids)
+    )
+    per_slice = len(jax.devices()) // n_slices
+    if not dcn_axes and not ici_axes:
+        dcn_axes = {"data": n_slices}
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    # create_hybrid_device_mesh wants equal-rank shapes: DCN axes lead with
+    # the ICI dims at 1, and vice versa; the result is their elementwise
+    # product, i.e. [*dcn_sizes, *ici_sizes]
+    ici_shape = [1] * len(dcn_axes) + list(ici_axes.values())
+    dcn_shape = list(dcn_axes.values()) + [1] * len(ici_axes)
+    ici_total = math.prod(ici_shape)
+    if ici_total > per_slice:
+        raise ValueError(
+            f"ICI axes {ici_axes} need {ici_total} devices but each slice "
+            f"has {per_slice}; move an axis into dcn_axes"
+        )
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=ici_shape,
+        dcn_mesh_shape=dcn_shape,
+        devices=jax.devices(),
+    )
+    return Mesh(grid, axis_names=names)
+
+
 def host_cpu_devices(n: int) -> list[jax.Device]:
     """CPU devices for mesh simulation in tests (the TPU-world analogue of the
     reference's gloo-on-localhost fake cluster, SURVEY §4). Requires

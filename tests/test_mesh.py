@@ -1,0 +1,31 @@
+"""Mesh construction, including the multi-host hybrid builder's
+single-process fallback."""
+
+import jax
+import pytest
+
+from ddl25spring_tpu.utils.mesh import (
+    make_hybrid_mesh,
+    make_mesh,
+    mesh_axis_sizes,
+)
+
+
+def test_make_mesh_infer_axis(devices8):
+    mesh = make_mesh(devices8, data=-1, stage=2)
+    assert mesh_axis_sizes(mesh) == {"data": 4, "stage": 2}
+
+
+def test_make_mesh_too_many_devices_raises(devices8):
+    with pytest.raises(ValueError):
+        make_mesh(devices8[:2], data=4)
+
+
+def test_hybrid_mesh_single_process_fallback(devices8):
+    # one process (this test environment): DCN axes collapse into a flat
+    # mesh with the same axis names/sizes, so code written for the hybrid
+    # topology runs unchanged on a single host
+    assert jax.process_count() == 1
+    mesh = make_hybrid_mesh({"data": 2}, stage=2, model=2)
+    assert mesh_axis_sizes(mesh) == {"data": 2, "stage": 2, "model": 2}
+    assert tuple(mesh.axis_names) == ("data", "stage", "model")
