@@ -1,15 +1,26 @@
 """Headline benchmark: the BASELINE.json north-star config.
 
 North star (`BASELINE.json`): DP+PP ResNet-18/CIFAR-10 via the `run-b2.sh`
-path at >= 5,000 samples/sec/chip.  This bench runs that path with the
-**native C++ streaming input pipeline as the primary metric** — a fresh
-prefetched, shuffled, raw-uint8 batch crosses the host->device link every
-step, so the number includes real input cost — and the fixed device-resident
-batch as a secondary line (pure device compute, the flattering number
-rounds 1-2 reported as the headline).  The train step itself is built by
+path at >= 5,000 samples/sec/chip.  The train step is built by
 ``ddl25spring_tpu.benchmarks.build_resnet_step`` — the same builder
 `lab/s01_b2_dp_pp.py` uses, so the bench cannot drift from what run-b2.sh
 runs.  Normalization happens device-side inside the jitted step.
+
+**Primary input mode: HBM-resident dataset with on-device epoch shuffle**
+(``DeviceDataset``) — the whole 147 MiB uint8 train split lives on device;
+every timed step consumes a fresh, disjoint, epoch-permuted batch gathered
+on device.  Real input semantics (unlike rounds 1-2's single re-fed batch),
+zero steady-state host->device traffic (the TPU-native input design for
+datasets that fit HBM).  Two secondary lines keep the bench honest:
+
+- ``native-stream-uint8``: the C++ prefetcher pushes a fresh batch across
+  the host->device link every step.  On this image that link is a network
+  tunnel measured at ~10-20 MiB/s (vs multi-GiB/s PCIe on a real TPU VM),
+  which bounds ANY host-streaming input at ~3-6k samples/s; the measured
+  link bandwidth is emitted as ``h2d_mib_per_s`` so the number is
+  self-describing.
+- ``fixed-device-batch``: one device-resident batch re-fed (pure compute,
+  the upper bound).
 
 Topology: DP+PP (2-stage heterogeneous pipeline x DP) when >= 2 chips are
 attached, pure DP on a single chip — the emitted JSON names the layout it
@@ -17,9 +28,9 @@ actually ran.
 
 Driver contract: print ONE JSON line with at least
 ``{"metric", "value", "unit", "vs_baseline"}``.  Extra self-describing
-fields: ``input`` (streaming vs fixed), ``data`` (real vs synthetic CIFAR),
-``topology``, ``chip``, ``mfu``, ``achieved_tflops_per_chip``,
-``secondary`` (the fixed-batch run).  If the TPU tunnel is unreachable the
+fields: ``input``, ``data`` (real vs synthetic CIFAR), ``topology``,
+``chip``, ``mfu``, ``achieved_tflops_per_chip``, ``secondary`` (list: the
+streaming and fixed-batch runs).  If the TPU tunnel is unreachable the
 device probe times out and ONE JSON line with an ``error`` field is printed
 instead of hanging the driver.
 """
@@ -82,7 +93,10 @@ def main(argv=None) -> None:
         }))
         return
 
+    import time
+
     from ddl25spring_tpu.benchmarks import (
+        DeviceDataset,
         InputFeed,
         build_resnet_step,
         report_line,
@@ -97,38 +111,70 @@ def main(argv=None) -> None:
     step, params, opt_state, meta = build_resnet_step(devices, dp, S, M, batch)
     n_chips = meta["n_chips"]
 
-    feed = InputFeed(
-        batch, stream=True,
-        workers=max(2, (os.cpu_count() or 4) // 2), prefetch_depth=6,
-    )
+    ds = DeviceDataset(batch)
 
-    # --- timed runs --------------------------------------------------------
+    # --- primary: HBM-resident dataset, on-device epoch shuffle ------------
     dt, params, opt_state = timed_run(
-        step, params, opt_state, feed.feed, args.steps, args.warmup
+        step, params, opt_state, ds.feed, args.steps, args.warmup
     )
     sps_chip = args.steps * batch / dt / n_chips
 
+    # --- secondary 1: host streaming through the native C++ loader ---------
+    # Constructed only now, and warmed past the prefetch queue's capacity
+    # (depth + in-flight workers), so the timed window starts with an empty
+    # queue and measures steady-state producer-bound throughput — a queue
+    # pre-filled during the primary run would hand the timed loop several
+    # batches for free and inflate the number.
+    workers = max(2, (os.cpu_count() or 4) // 2)
+    depth = 6
+    feed = InputFeed(batch, stream=True, workers=workers, prefetch_depth=depth)
+    stream_warm = args.warmup + depth + workers
+    dt_s, params, opt_state = timed_run(
+        step, params, opt_state, feed.feed, args.steps, stream_warm
+    )
+    sps_chip_stream = args.steps * batch / dt_s / n_chips
+
+    # --- secondary 2: one fixed device-resident batch (compute bound) ------
     dt2, params, opt_state = timed_run(
         step, params, opt_state, feed.feed_fixed, args.steps, args.warmup
     )
     sps_chip_fixed = args.steps * batch / dt2 / n_chips
+
+    # measure the host->device link so the streaming line explains itself
+    import numpy as np
+
+    buf = np.zeros(4 * 1024 * 1024, np.uint8)
+    jax.device_put(buf[:1024], devices[0]).block_until_ready()
+    t0 = time.perf_counter()
+    jax.device_put(buf, devices[0]).block_until_ready()
+    h2d_mib_s = 4.0 / (time.perf_counter() - t0)
 
     flops_step = compiled_flops(step, params, opt_state, feed.fixed)
     achieved_tf, frac = mfu(flops_step, dt / args.steps, n_chips, meta["device"])
     peak = chip_peak_flops(meta["device"])
 
     print(report_line(
-        meta["layout"], sps_chip, feed.input_mode, frac, achieved_tf,
-        data=feed.provenance,
+        meta["layout"], sps_chip, ds.input_mode, frac, achieved_tf,
+        data=ds.provenance,
         topology=meta["topology"],
         chip=f"{meta['device'].device_kind} x{n_chips}",
         flops_per_step=flops_step,
         peak_tflops_per_chip=peak / 1e12 if peak else None,
-        secondary={
-            "input": "fixed-device-batch",
-            "value": round(sps_chip_fixed, 1),
-            "unit": "samples/sec/chip",
-        },
+        h2d_mib_per_s=round(h2d_mib_s, 1),
+        secondary=[
+            {
+                "input": feed.input_mode,
+                "value": round(sps_chip_stream, 1),
+                "unit": "samples/sec/chip",
+                "note": "bounded by the tunneled host->device link "
+                        f"(~{h2d_mib_s:.0f} MiB/s here; GiB/s on a TPU VM)",
+            },
+            {
+                "input": "fixed-device-batch",
+                "value": round(sps_chip_fixed, 1),
+                "unit": "samples/sec/chip",
+            },
+        ],
     ))
 
     feed.close()

@@ -9,6 +9,11 @@ from ddl25spring_tpu.parallel.ep import (
     moe_ffn,
     shard_moe_params,
 )
+from ddl25spring_tpu.parallel.zero import (
+    make_zero_dp_train_step,
+    zero_shard_params,
+    zero_unshard_params,
+)
 
 __all__ = [
     "make_dp_train_step",
@@ -18,4 +23,7 @@ __all__ = [
     "make_ep_moe_fn",
     "moe_ffn",
     "shard_moe_params",
+    "make_zero_dp_train_step",
+    "zero_shard_params",
+    "zero_unshard_params",
 ]

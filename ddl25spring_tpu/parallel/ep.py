@@ -82,8 +82,11 @@ def _dispatch_tensors(router_logits: jax.Array, capacity: int):
         pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32
     )[:, None, :]                                          # [T, E, C]
     combine = disp * gate[:, None, None]
-    # Switch aux loss: E * sum_e fraction_e * mean-prob_e
-    frac = keep.sum(0) / jnp.maximum(onehot.sum(), 1.0)
+    # Switch aux loss: E * sum_e fraction_e * mean-prob_e.  fraction_e is
+    # the ASSIGNED fraction (pre-drop routing decisions), not the kept
+    # fraction — keep.sum(0) saturates at C under overflow, which would
+    # under-penalize imbalance exactly when drops occur
+    frac = onehot.sum(0) / jnp.maximum(onehot.sum(), 1.0)
     aux = E * jnp.sum(frac * probs.mean(0))
     return disp, combine, aux
 

@@ -116,6 +116,13 @@ def make_hybrid_mesh(
             f"ICI axes {ici_axes} need {ici_total} devices but each slice "
             f"has {per_slice}; move an axis into dcn_axes"
         )
+    dcn_total = math.prod(dcn_shape)
+    if dcn_total != n_slices:
+        raise ValueError(
+            f"DCN axes {dcn_axes} have product {dcn_total} but there are "
+            f"{n_slices} slices; the cross-slice axes must tile the slice "
+            "grid exactly (add or resize a dcn axis)"
+        )
     grid = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=ici_shape,
         dcn_mesh_shape=dcn_shape,

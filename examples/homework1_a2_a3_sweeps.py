@@ -33,8 +33,11 @@ def run_one(server_cls, rounds: int, **kw):
     return res
 
 
-def sweep_a2(rounds: int, ns, cs, lr: float, seed: int):
-    for cls, name in ((FedSgdGradientServer, "FedSGD"), (FedAvgServer, "FedAvg")):
+def sweep_a2(rounds: int, ns, cs, lr: float, seed: int, server: str = "both"):
+    pairs = [(FedSgdGradientServer, "FedSGD"), (FedAvgServer, "FedAvg")]
+    if server != "both":
+        pairs = [p for p in pairs if p[1].lower() == server]
+    for cls, name in pairs:
         print(f"\n=== A2 {name}: client-count sweep (C=0.1) ===")
         for n in ns:
             res = run_one(
@@ -81,6 +84,10 @@ def main(argv=None):
     ap.add_argument("--n-test", type=int, default=0)
     ap.add_argument("--force-cpu-devices", type=int, default=0,
                     metavar="N", help="simulate an N-device CPU mesh")
+    ap.add_argument("--only", choices=("all", "a2", "a3"), default="all",
+                    help="run a subset of the grid (resume partial sweeps)")
+    ap.add_argument("--server", choices=("both", "fedsgd", "fedavg"),
+                    default="both", help="A2: restrict to one server family")
     args = ap.parse_args(argv)
 
     from ddl25spring_tpu.utils.platform import force_cpu_devices
@@ -102,8 +109,10 @@ def main(argv=None):
     else:
         ns, cs, es, rounds = [10, 50, 100], [0.01, 0.1, 0.2], [1, 5, 10], \
             args.rounds
-    sweep_a2(rounds, ns, cs, args.lr, args.seed)
-    sweep_a3(rounds, es, args.lr, args.seed)
+    if args.only in ("all", "a2"):
+        sweep_a2(rounds, ns, cs, args.lr, args.seed, server=args.server)
+    if args.only in ("all", "a3"):
+        sweep_a3(rounds, es, args.lr, args.seed)
 
 
 if __name__ == "__main__":

@@ -49,15 +49,20 @@ def parse_args(argv=None):
                     help="llama workload: checkpoint/resume directory; a "
                          "relaunched run continues from the latest step")
     ap.add_argument("--ckpt-every", type=int, default=100)
-    ap.add_argument("--stream", dest="stream", action="store_true",
-                    default=None,
-                    help="resnet: force streaming through the native C++ "
-                         "prefetching loader (synthesizes CIFAR-format "
-                         "binaries if none exist).  Default: auto — stream "
-                         "when real binaries are present "
-                         "(DDL25_CIFAR10_DIR / data/cifar-10-batches-bin)")
-    ap.add_argument("--no-stream", dest="stream", action="store_false",
-                    help="resnet: always reuse one device-resident batch")
+    ap.add_argument("--input", choices=("auto", "hbm", "stream", "fixed"),
+                    default="auto",
+                    help="resnet input pipeline: 'hbm' = whole train split "
+                         "resident in device memory with on-device epoch "
+                         "shuffle (zero steady-state host->device traffic — "
+                         "the TPU-native path for datasets that fit HBM); "
+                         "'stream' = native C++ prefetching loader pushing a "
+                         "fresh uint8 batch over the host link every step; "
+                         "'fixed' = one device-resident batch re-fed (pure "
+                         "compute).  'auto' = hbm (CIFAR-10 is 147 MiB)")
+    ap.add_argument("--stream", dest="input", action="store_const",
+                    const="stream", help="alias for --input stream")
+    ap.add_argument("--no-stream", dest="input", action="store_const",
+                    const="fixed", help="alias for --input fixed")
     ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
                     help="llama: pipeline schedule (1f1b bounds activation "
                          "memory at O(S) instead of O(M))")
@@ -225,17 +230,21 @@ def run_resnet(args, jax, jnp):
     batch = args.batch or (1024 if on_tpu else 4) * n_used
     batch = batch // (dp * M) * (dp * M)
 
-    # the SAME builder + input pipeline bench.py uses (benchmarks.py): raw
-    # uint8 batches in, normalization fused into the jitted step; streaming
-    # auto-on when CIFAR binaries exist, --stream forces, --no-stream opts out
+    # the SAME builder + input pipelines bench.py uses (benchmarks.py): raw
+    # uint8 batches in, normalization fused into the jitted step
     step, params, opt_state, meta = build_resnet_step(
         devices, dp, S, M, batch, lr=args.lr or 0.1
     )
-    feed = InputFeed(batch, stream=args.stream)
+    mode = "hbm" if args.input == "auto" else args.input
+    if mode == "hbm":
+        from ddl25spring_tpu.benchmarks import DeviceDataset
+
+        feed = DeviceDataset(batch)
+    else:
+        feed = InputFeed(batch, stream=(mode == "stream"))
 
     print(f"resnet18/cifar10: {meta['topology']}, global batch={batch}, "
-          f"{n_used}/{n} device(s) in mesh"
-          + (", native streaming input" if feed.streaming else ""))
+          f"{n_used}/{n} device(s) in mesh, input={feed.input_mode}")
 
     import contextlib
 
