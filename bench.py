@@ -166,8 +166,15 @@ def main(argv=None) -> None:
                 "input": feed.input_mode,
                 "value": round(sps_chip_stream, 1),
                 "unit": "samples/sec/chip",
-                "note": "bounded by the tunneled host->device link "
-                        f"(~{h2d_mib_s:.0f} MiB/s here; GiB/s on a TPU VM)",
+                # only claim link-bound streaming when the native loader
+                # actually streamed; on NativeLoaderUnavailable this run
+                # degraded to the fixed batch and says so via input_mode
+                **({"note": "bounded by the tunneled host->device link "
+                            f"(~{h2d_mib_s:.0f} MiB/s here; GiB/s on a "
+                            "TPU VM)"}
+                   if feed.streaming else
+                   {"note": "native loader unavailable; fell back to the "
+                            "fixed device-resident batch"}),
             },
             {
                 "input": "fixed-device-batch",

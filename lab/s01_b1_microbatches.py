@@ -137,8 +137,8 @@ def main(argv=None) -> None:
     fl = compiled_flops(step, staged, opt_state, tokens)
     tf, frac = mfu(fl, dt / args.iters, n_chips, devices[0])
     if tf is not None:
-        print(f"achieved {tf:.1f} TFLOP/s/chip"
-              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+        print(f"achieved {tf:.2f} TFLOP/s/chip"
+              + (f" (MFU {frac:.2%})" if frac is not None else ""))
     if args.trace_dir:
         print(f"profiler trace written to {args.trace_dir}")
 

@@ -201,8 +201,8 @@ def run_llama(args, jax, jnp):
     fl = compiled_flops(step, staged, opt_state, tokens_w)
     tf, frac = mfu(fl, dt / iters, dp * S, devices[0])
     if tf is not None:
-        print(f"achieved {tf:.1f} TFLOP/s/chip"
-              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+        print(f"achieved {tf:.2f} TFLOP/s/chip"
+              + (f" (MFU {frac:.2%})" if frac is not None else ""))
     if args.trace_dir:
         print(f"profiler trace written to {args.trace_dir}")
 
@@ -235,7 +235,12 @@ def run_resnet(args, jax, jnp):
     step, params, opt_state, meta = build_resnet_step(
         devices, dp, S, M, batch, lr=args.lr or 0.1
     )
-    mode = "hbm" if args.input == "auto" else args.input
+    if args.input == "auto":
+        # hbm needs batch <= dataset size (50k CIFAR rows); on a slice big
+        # enough to exceed that, auto degrades to the streaming loader
+        mode = "hbm" if batch <= 50_000 else "stream"
+    else:
+        mode = args.input
     if mode == "hbm":
         from ddl25spring_tpu.benchmarks import DeviceDataset
 
@@ -270,8 +275,8 @@ def run_resnet(args, jax, jnp):
     fl = compiled_flops(step, params, opt_state, feed.fixed)
     tf, frac = mfu(fl, dt / iters, n_used, devices[0])
     if tf is not None:
-        print(f"achieved {tf:.1f} TFLOP/s/chip"
-              + (f" (MFU {frac:.1%})" if frac is not None else ""))
+        print(f"achieved {tf:.2f} TFLOP/s/chip"
+              + (f" (MFU {frac:.2%})" if frac is not None else ""))
     if args.trace_dir:
         print(f"profiler trace written to {args.trace_dir}")
     print(report_line(meta["layout"], sps_chip, feed.input_mode, frac, tf))

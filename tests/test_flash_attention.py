@@ -44,6 +44,59 @@ def test_flash_matches_dense(L, block):
     )
 
 
+@pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64)])
+def test_flash_unequal_blocks(bq, bk):
+    """bq != bk exercises the diagonal-crossing live/finalize conditions of
+    the 3-D-grid kernels (j_last = ((i+1)bq-1)//bk) in both directions."""
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv, kt = jax.random.split(key, 4)
+    shape = (1, 256, 2, 32)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    t = jax.random.normal(kt, shape, jnp.float32)
+
+    out = flash_attention(q, k, v, block_q=bq, block_k=bk, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense(q, k, v)), atol=2e-5
+    )
+
+    def f_flash(q, k, v):
+        return (flash_attention(
+            q, k, v, block_q=bq, block_k=bk, interpret=True) * t).sum()
+
+    def f_dense(q, k, v):
+        return (dense(q, k, v) * t).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_flash_non_causal():
+    """causal=False takes the other branch of every live/j_last condition."""
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (2, 128, 2, 32)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    out = flash_attention(
+        q, k, v, causal=False, block_q=64, block_k=64, interpret=True
+    )
+    # dense non-causal reference
+    B, L, H, hd = shape
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt_ = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+    s = jnp.einsum("bhld,bhmd->bhlm", qt, kt_) / (hd ** 0.5)
+    ref = jnp.einsum(
+        "bhlm,bhmd->bhld", jax.nn.softmax(s, axis=-1), vt
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_flash_grads_match_dense():
     key = jax.random.PRNGKey(1)
     kq, kk, kv, kt = jax.random.split(key, 4)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""On-TPU microbenchmark: Pallas flash attention vs dense attention.
+
+Times forward+backward of causal attention at growing context lengths and
+prints a table (ms/iter, speedup, attention TFLOP/s).  The dense path is
+``models.llama.causal_attention`` (fp32 softmax, the exact fallback the
+model uses off-TPU); the flash path is ``ops.flash_attention`` (the
+default on TPU).  Rationale: the reference fixes ctx at 256
+(`lab/s01_b1_microbatches.py:24`) where dense is fine; flash is what makes
+"ctx >> 256" viable — this records the crossover and the win.
+
+Run on the real chip: ``python tools/flash_attention_bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ctxs", type=int, nargs="+",
+                    default=[512, 1024, 2048, 4096])
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=6)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddl25spring_tpu.models.llama import causal_attention
+    from ddl25spring_tpu.ops.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    dtype = jnp.bfloat16 if dev.platform == "tpu" else jnp.float32
+    print(f"device: {dev.device_kind or dev.platform}, dtype: {dtype.__name__}, "
+          f"B={args.batch} H={args.heads} hd={args.head_dim}, "
+          f"fwd+bwd, {args.iters} iters")
+    print(f"{'ctx':>6} {'dense ms':>9} {'flash ms':>9} {'speedup':>8} "
+          f"{'flash TF/s':>10}")
+
+    for L in args.ctxs:
+        key = jax.random.PRNGKey(0)
+        shape = (args.batch, L, args.heads, args.head_dim)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i), shape,
+                                     dtype) for i in range(3))
+
+        def loss_dense(q, k, v):
+            return causal_attention(q, k, v, dtype).astype(jnp.float32).sum()
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v).astype(jnp.float32).sum()
+
+        def timeit(f):
+            g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            r = g(q, k, v)  # compile
+            jax.block_until_ready(r)
+            float(r[0].astype(jnp.float32).sum())
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                r = g(q, k, v)
+            float(r[0].astype(jnp.float32).sum())
+            return (time.perf_counter() - t0) / args.iters
+
+        try:
+            td = timeit(loss_dense)
+        except Exception as e:  # noqa: BLE001
+            if "memory" not in str(e).lower() and "hbm" not in str(e).lower():
+                raise  # only OOM is an expected dense failure
+            td = None
+        tf_ = timeit(loss_flash)
+        # causal attention FLOPs (fwd 2*2, bwd ~2x fwd): ~3.5 * 4 * B*H*L^2*hd
+        # halved for causal masking
+        flops = 3.5 * 4 * args.batch * args.heads * L * L * args.head_dim / 2
+        dense_s = f"{td * 1e3:>9.2f}" if td else "  OOM(hbm)"
+        speed_s = f"{td / tf_:>7.2f}x" if td else "       -"
+        print(f"{L:>6} {dense_s} {tf_ * 1e3:>9.2f} {speed_s} "
+              f"{flops / tf_ / 1e12:>10.1f}")
+
+
+if __name__ == "__main__":
+    main()
