@@ -42,17 +42,25 @@ def _dense(key, shape, scale=0.02):
 def init_block_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     d, f = cfg.dmodel, cfg.ffn_dim
     ks = jax.random.split(key, 7)
-    return {
+    p = {
         "ln1": jnp.ones((d,), jnp.float32),
         "wq": _dense(ks[0], (d, d)),
         "wk": _dense(ks[1], (d, d)),
         "wv": _dense(ks[2], (d, d)),
         "wo": _dense(ks[3], (d, d)),
         "ln2": jnp.ones((d,), jnp.float32),
-        "w_gate": _dense(ks[4], (d, f)),
-        "w_up": _dense(ks[5], (d, f)),
-        "w_down": _dense(ks[6], (f, d)),
     }
+    if cfg.n_experts > 0:
+        # switch-MoE FFN (Switch Transformer, every block): router +
+        # stacked bias-free SwiGLU experts, shared init with parallel/ep.py
+        from ddl25spring_tpu.parallel.ep import init_moe_params
+
+        p["moe"] = init_moe_params(ks[4], d, f, cfg.n_experts)
+    else:
+        p["w_gate"] = _dense(ks[4], (d, f))
+        p["w_up"] = _dense(ks[5], (d, f))
+        p["w_down"] = _dense(ks[6], (f, d))
+    return p
 
 
 def init_llama_params(key: jax.Array, cfg: LlamaConfig) -> Params:
@@ -122,9 +130,14 @@ def block_forward(
     tp_axis: str | None = None,
     pos: jax.Array | None = None,
     attn_fn=None,
-) -> jax.Array:
+    moe_fn=None,
+) -> tuple[jax.Array, jax.Array]:
     """One pre-norm transformer block: RMSNorm -> causal RoPE attention ->
-    residual -> RMSNorm -> SwiGLU -> residual.
+    residual -> RMSNorm -> FFN -> residual.  Returns ``(x, aux)`` where
+    ``aux`` is the switch-MoE load-balancing loss when ``cfg.n_experts > 0``
+    (SwiGLU dense FFN and ``aux = 0.0`` otherwise).  ``moe_fn`` overrides
+    the single-device ``ep.moe_ffn`` — inject ``ep.make_ep_moe_fn(mesh)``
+    for expert-parallel FFNs, mirroring the ``attn_fn`` hook.
 
     Parallel hooks (both off by default = the serial block):
 
@@ -174,25 +187,59 @@ def block_forward(
     x = x + attn_out
 
     h = rms_norm(x, p["ln2"])
-    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
-    up = h @ p["w_up"].astype(dtype)
-    ffn_out = (gate * up) @ p["w_down"].astype(dtype)
+    if cfg.n_experts > 0:
+        if tp_axis is not None:
+            # TP param specs don't cover the moe subtree, and the
+            # row-parallel psum below would scale a replicated MoE output
+            # by the axis size — reject rather than silently mis-train
+            raise NotImplementedError(
+                "switch-MoE blocks are not supported under tensor "
+                "parallelism; use DP/ZeRO (or EP via moe_fn) instead"
+            )
+        if moe_fn is None:
+            from ddl25spring_tpu.parallel.ep import moe_ffn
+
+            def moe_fn(mp, flat):
+                return moe_ffn(mp, flat, capacity_factor=cfg.capacity_factor)
+
+        # tokens flattened [B*L, D]: ONE dispatch group per call, so under
+        # capacity overflow a token's drop decision depends on the other
+        # rows in the batch (inherent to switch-style bucketed dispatch;
+        # examples are independent whenever nothing overflows)
+        y, aux = moe_fn(p["moe"], h.reshape(B * L, D))
+        ffn_out = y.reshape(B, L, D).astype(dtype)
+    else:
+        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+        up = h @ p["w_up"].astype(dtype)
+        ffn_out = (gate * up) @ p["w_down"].astype(dtype)
+        aux = jnp.float32(0.0)
     if tp_axis is not None:
         ffn_out = lax.psum(ffn_out, tp_axis)
     x = x + ffn_out
-    return x
+    return x, aux
 
 
 def apply_blocks(
-    stacked: Params, x: jax.Array, cfg: LlamaConfig, **block_kw
-) -> jax.Array:
+    stacked: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    with_aux: bool = False,
+    **block_kw,
+):
     """Apply a stack of blocks (leading layer axis) via ``lax.scan`` — the
-    compiler-friendly loop (one block body compiled once)."""
+    compiler-friendly loop (one block body compiled once).
+
+    ``with_aux=True`` additionally returns the summed MoE load-balancing
+    aux loss over layers (0.0 for dense-FFN configs) — opt-in so the
+    pipeline/TP/SP callers keep their single-output contract."""
 
     def body(h, block_p):
-        return block_forward(block_p, h, cfg, **block_kw), None
+        h, aux = block_forward(block_p, h, cfg, **block_kw)
+        return h, aux
 
-    out, _ = lax.scan(body, x, stacked)
+    out, aux = lax.scan(body, x, stacked)
+    if with_aux:
+        return out, aux.sum()
     return out
 
 
@@ -215,6 +262,19 @@ def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Ar
     x = embed(params, tokens, cfg)
     x = apply_blocks(params["blocks"], x, cfg)
     return unembed(params, x, cfg)
+
+
+def llama_forward_with_aux(
+    params: Params, tokens: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Forward returning ``(logits, moe_aux)``.  Training a switch-MoE
+    config (``cfg.n_experts > 0``) should minimize ``causal_lm_loss(logits,
+    tokens) + cfg.moe_aux_weight * moe_aux`` so the router learns to
+    balance expert load (Switch Transformer recipe); ``moe_aux`` is 0.0
+    for dense-FFN configs."""
+    x = embed(params, tokens, cfg)
+    x, aux = apply_blocks(params["blocks"], x, cfg, with_aux=True)
+    return unembed(params, x, cfg), aux
 
 
 # ---------------------------------------------------------------- stage split

@@ -379,6 +379,11 @@ def make_pipeline_train_step(
     interleaved schedule, parity with ``intro_PP_1F1B.py`` generalized to
     M microbatches — see :func:`make_1f1b_value_and_grad`).
     """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the aux loss would be silently dropped here)"
+        )
     if schedule == "1f1b":
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis

@@ -154,6 +154,11 @@ def make_sp_train_step(
     data_axis: str | None = None,
 ):
     """Jitted SP(xDP) train step (params replicated, tokens seq-sharded)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the aux loss would be silently dropped here)"
+        )
     loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis)
 
     @jax.jit

@@ -101,6 +101,11 @@ def make_tp_train_step(
     data_axis: str | None = None,
 ):
     """Jitted TP(xDP) train step; params stay sharded across steps."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the aux loss would be silently dropped here)"
+        )
     loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis)
 
     @jax.jit

@@ -24,6 +24,9 @@ class LlamaConfig:
     pad_id: int = 0
     dtype: str = "bfloat16"     # MXU-friendly compute dtype; params stay fp32
     use_flash: bool = False     # Pallas flash-attention kernel for the hot op
+    n_experts: int = 0          # > 0: switch-MoE FFN in every block
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance aux loss weight
 
     @property
     def head_dim(self) -> int:

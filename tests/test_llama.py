@@ -63,3 +63,80 @@ def test_rope_rotation_preserves_norm():
     np.testing.assert_allclose(
         jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(r, axis=-1), rtol=1e-5
     )
+
+
+# ---------------------------------------------------------------- MoE LLaMA
+
+
+def test_moe_llama_forward_and_aux():
+    """Switch-MoE blocks (cfg.n_experts > 0): logits well-formed, causality
+    holds through capacity-bucketed dispatch, aux > 0 and ~1 for a fresh
+    (roughly uniform) router."""
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32", n_experts=4, capacity_factor=2.0,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    assert "moe" in params["blocks"] and "w_gate" not in params["blocks"]
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    logits, aux = llama.llama_forward_with_aux(params, tokens, cfg)
+    assert logits.shape == (2, 16, 64)
+    assert bool(jnp.isfinite(logits).all())
+    # per-layer switch aux is ~1 at balanced routing; 2 layers -> ~2
+    assert 0.5 < float(aux) < 8.0
+
+    # causality survives the token-flattened dispatch: with
+    # capacity_factor=2.0 nothing overflows, so examples are independent
+    # (under overflow switch-style dispatch IS batch-coupled — drops
+    # depend on slot competition; documented in block_forward)
+    logits_b, _ = llama.llama_forward_with_aux(
+        params, tokens.at[0, 10].set((tokens[0, 10] + 1) % 64), cfg
+    )
+    np.testing.assert_allclose(
+        logits[0, :10], logits_b[0, :10], atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(logits[1], logits_b[1], atol=1e-5, rtol=1e-5)
+
+
+def test_moe_llama_trains():
+    """The full switch recipe: LM loss + weighted aux falls under Adam."""
+    import optax
+
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32", n_experts=4, capacity_factor=2.0,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
+            return causal_lm_loss(logits, tokens) + cfg.moe_aux_weight * aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
+    assert all(np.isfinite(losses))
+
+    # router grads actually flow (the dispatch is differentiable through
+    # the gate weighting + aux loss)
+    def loss_fn(p):
+        logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
+        from ddl25spring_tpu.ops.losses import causal_lm_loss as cl
+        return cl(logits, tokens) + cfg.moe_aux_weight * aux
+
+    grads = jax.grad(loss_fn)(params)
+    router_g = grads["blocks"]["moe"]["router"]
+    assert float(jnp.abs(router_g).max()) > 0.0
