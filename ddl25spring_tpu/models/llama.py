@@ -136,8 +136,10 @@ def block_forward(
     residual -> RMSNorm -> FFN -> residual.  Returns ``(x, aux)`` where
     ``aux`` is the switch-MoE load-balancing loss when ``cfg.n_experts > 0``
     (SwiGLU dense FFN and ``aux = 0.0`` otherwise).  ``moe_fn`` overrides
-    the single-device ``ep.moe_ffn`` — inject ``ep.make_ep_moe_fn(mesh)``
-    for expert-parallel FFNs, mirroring the ``attn_fn`` hook.
+    the single-device ``ep.moe_ffn`` — inject
+    ``ep.make_ep_moe_fn(mesh, capacity_factor=cfg.capacity_factor)`` for
+    expert-parallel FFNs, mirroring the ``attn_fn`` hook (pass the config's
+    capacity explicitly: the EP builder cannot see ``cfg``).
 
     Parallel hooks (both off by default = the serial block):
 

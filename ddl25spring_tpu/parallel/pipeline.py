@@ -80,6 +80,11 @@ def make_pipeline_loss(
     residuals live) and the 1F1B schedule (M-invariant stash,
     :func:`make_1f1b_value_and_grad`).
     """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the MoE aux loss would be silently dropped here)"
+        )
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
@@ -204,6 +209,11 @@ def make_1f1b_value_and_grad(
     Returns ``f(params, tokens) -> (loss, grads)`` with the same contract as
     ``jax.value_and_grad(make_pipeline_loss(...))``.
     """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the MoE aux loss would be silently dropped here)"
+        )
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)

@@ -99,6 +99,11 @@ def make_sp_loss(
     sharded ``[B, L/n]`` over ``seq_axis`` and ring attention in every block.
     Matches :func:`~ddl25spring_tpu.models.llama.llama_forward` + causal-LM
     loss on the unsharded model."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
+            "(the MoE aux loss would be silently dropped here)"
+        )
     n = mesh.shape[seq_axis]
 
     @partial(

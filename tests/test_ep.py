@@ -117,3 +117,42 @@ def test_moe_trains(setup, devices8):
         ps, opt, l = step(ps, opt)
         losses.append(float(l))
     assert losses[-1] < losses[0]
+
+
+def test_moe_llama_with_ep_moe_fn(devices8):
+    """Model-level EP composition: the llama moe_fn hook routed through
+    make_ep_moe_fn equals the single-device moe_ffn path at ample capacity
+    (same tokens, same params, expert axis = 2)."""
+
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.parallel.ep import make_ep_moe_fn
+    from ddl25spring_tpu.utils.config import LlamaConfig
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32", n_experts=4, capacity_factor=4.0,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+
+    ref_logits, ref_aux = llama.llama_forward_with_aux(params, tokens, cfg)
+
+    mesh = make_mesh(devices8[:2], expert=2)
+    ep_fn = make_ep_moe_fn(mesh, capacity_factor=cfg.capacity_factor)
+
+    def fwd_ep(p, toks):
+        x = llama.embed(p, toks, cfg)
+        x, aux = llama.apply_blocks(
+            p["blocks"], x, cfg, with_aux=True, moe_fn=ep_fn
+        )
+        return llama.unembed(p, x, cfg), aux
+
+    ep_logits, ep_aux = jax.jit(fwd_ep)(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(ep_logits), atol=2e-4, rtol=2e-4
+    )
+    # aux estimators differ (per-shard vs global buckets) but must stay
+    # finite and in the same ballpark as the reference
+    assert np.isfinite(float(ep_aux))
+    np.testing.assert_allclose(float(ref_aux), float(ep_aux), rtol=0.25)

@@ -113,12 +113,12 @@ def test_moe_llama_trains():
     tx = optax.adam(1e-2)
     opt_state = tx.init(params)
 
+    def loss_fn(p):
+        logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
+        return causal_lm_loss(logits, tokens) + cfg.moe_aux_weight * aux
+
     @jax.jit
     def step(params, opt_state):
-        def loss_fn(p):
-            logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
-            return causal_lm_loss(logits, tokens) + cfg.moe_aux_weight * aux
-
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
@@ -132,11 +132,6 @@ def test_moe_llama_trains():
 
     # router grads actually flow (the dispatch is differentiable through
     # the gate weighting + aux loss)
-    def loss_fn(p):
-        logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
-        from ddl25spring_tpu.ops.losses import causal_lm_loss as cl
-        return cl(logits, tokens) + cfg.moe_aux_weight * aux
-
     grads = jax.grad(loss_fn)(params)
     router_g = grads["blocks"]["moe"]["router"]
     assert float(jnp.abs(router_g).max()) > 0.0
