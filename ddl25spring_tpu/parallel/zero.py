@@ -92,6 +92,7 @@ def make_zero_dp_train_step(
     params_template,
     axis: str = "data",
     per_shard_rng: bool = True,
+    num_microbatches: int = 1,
 ):
     """Build the fully-sharded trainstep.
 
@@ -106,7 +107,18 @@ def make_zero_dp_train_step(
     Caveat: the optax chain runs on LOCAL shards, so transforms needing a
     global reduction over the whole tree (e.g. ``clip_by_global_norm``)
     would compute shard-local norms; stick to elementwise transforms here.
+
+    ``num_microbatches > 1`` adds FSDP-style gradient accumulation: the
+    per-device batch is split along its leading dim and scanned — each
+    microbatch re-gathers params and reduce-scatters its gradient (the
+    standard FSDP schedule), while the accumulator holds only the SHARDED
+    ``[1, k]`` grads, so peak memory stays O(P/n) + one microbatch of
+    activations.  The update is mathematically the full-batch update
+    (mean of microbatch means; same reference semantics as
+    ``s01_b1_microbatches.py``'s ``.grad`` accumulation).
     """
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
     n = mesh.shape[axis]
     shapes = jax.tree.map(lambda l: jnp.shape(l), params_template)
     dtypes = jax.tree.map(lambda l: jnp.result_type(l), params_template)
@@ -135,14 +147,55 @@ def make_zero_dp_train_step(
             if per_shard_rng:
                 key = jax.random.fold_in(key, lax.axis_index(axis))
 
-            # all_gather inside the differentiated fn: its transpose IS the
-            # backward reduce-scatter, so full grads never materialize as a
-            # replicated tree — jax.grad w.r.t. the [1, k] shards.
-            def shard_loss(pshards):
-                params = gather_full(pshards)
-                return loss_fn(params, b, key)
+            def grads_for(mb, mb_key):
+                # all_gather inside the differentiated fn: its transpose IS
+                # the backward reduce-scatter, so full grads never
+                # materialize as a replicated tree — jax.grad w.r.t. the
+                # [1, k] shards.
+                def shard_loss(pshards):
+                    params = gather_full(pshards)
+                    return loss_fn(params, mb, mb_key)
 
-            loss, gshards = jax.value_and_grad(shard_loss)(pshards)
+                return jax.value_and_grad(shard_loss)(pshards)
+
+            if num_microbatches == 1:
+                loss, gshards = grads_for(b, key)
+            else:
+                # FSDP grad accumulation: scan microbatches; carry holds
+                # only SHARDED [1, k] grad sums
+                per_dev = jax.tree.leaves(b)[0].shape[0]
+                if per_dev % num_microbatches:
+                    raise ValueError(
+                        f"per-device batch {per_dev} not divisible by "
+                        f"num_microbatches={num_microbatches}"
+                    )
+                mbs = jax.tree.map(
+                    lambda x: x.reshape(
+                        (num_microbatches, x.shape[0] // num_microbatches)
+                        + x.shape[1:]
+                    ),
+                    b,
+                )
+
+                def acc_body(carry, mb_i):
+                    mb, i = mb_i
+                    l, g = grads_for(mb, jax.random.fold_in(key, i))
+                    return jax.tree.map(jnp.add, carry, (l, g)), None
+
+                zero_g = jax.tree.map(jnp.zeros_like, pshards)
+                # the per-microbatch loss is device-varying; the init must
+                # match (VMA typing under shard_map)
+                zero_l = lax.pcast(jnp.float32(0.0), axis, to="varying")
+                (loss, gshards), _ = lax.scan(
+                    acc_body,
+                    (zero_l, zero_g),
+                    (mbs, jnp.arange(num_microbatches)),
+                )
+                loss = loss / num_microbatches
+                gshards = jax.tree.map(
+                    lambda g: g / num_microbatches, gshards
+                )
+
             # the transpose of the tiled all_gather is a psum_scatter: each
             # device's gshards already hold the cross-device SUM of local
             # grads for its rows; ÷n converts sum to the DP mean

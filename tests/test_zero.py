@@ -106,3 +106,41 @@ def test_zero_per_device_memory(setup, devices8):
         shard0 = [s for s in leaf.addressable_shards if s.device == devices8[0]]
         per_dev += sum(s.data.size * s.data.dtype.itemsize for s in shard0)
     assert per_dev <= total / n + 1024  # 1/n plus padding slack
+
+
+@pytest.mark.parametrize("M", [2, 4])
+def test_zero_grad_accum_equals_full_batch(setup, M, devices8):
+    """FSDP-style microbatch accumulation (num_microbatches=M) must equal
+    the single-shot step on the same total batch (deterministic loss, no
+    dropout) — the reference's .grad-accumulation semantics
+    (s01_b1_microbatches.py) transplanted to sharded DP."""
+    data, params, loss_fn = setup
+    tx = optax.sgd(0.1, momentum=0.9)
+    mesh = make_mesh(devices8[:2], data=2)
+
+    batch = (
+        jnp.asarray(data["x_train"][:64]),
+        jnp.asarray(data["y_train"][:64]),
+    )
+    key = jax.random.PRNGKey(2)
+
+    one = make_zero_dp_train_step(
+        loss_fn, tx, mesh, params, per_shard_rng=False
+    )
+    acc = make_zero_dp_train_step(
+        loss_fn, tx, mesh, params, per_shard_rng=False, num_microbatches=M
+    )
+
+    s1 = zero_shard_params(params, mesh)
+    p1, _, l1 = one(s1, tx.init(s1), batch, key)
+    s2 = zero_shard_params(params, mesh)
+    p2, _, l2 = acc(s2, tx.init(s2), batch, key)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        jax.device_get(p1),
+        jax.device_get(p2),
+    )
