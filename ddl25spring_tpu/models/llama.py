@@ -260,7 +260,17 @@ def unembed(params: Params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 def llama_forward(params: Params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
     """Full unpartitioned forward: the serial side of the pipeline
-    equivalence oracle (SURVEY §4)."""
+    equivalence oracle (SURVEY §4).
+
+    Dense-FFN configs only: a switch-MoE config trained through this entry
+    would silently drop the router load-balancing aux loss, so it raises —
+    use :func:`llama_forward_with_aux` (mirroring the guards on the
+    tp/sp/pipeline loss builders)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "cfg.n_experts > 0: use llama_forward_with_aux so the MoE "
+            "load-balancing aux loss reaches the objective"
+        )
     x = embed(params, tokens, cfg)
     x = apply_blocks(params["blocks"], x, cfg)
     return unembed(params, x, cfg)
