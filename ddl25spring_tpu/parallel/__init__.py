@@ -11,6 +11,7 @@ from ddl25spring_tpu.parallel.ep import (
 )
 from ddl25spring_tpu.parallel.zero import (
     make_zero_dp_train_step,
+    zero_clip_by_global_norm,
     zero_shard_params,
     zero_unshard_params,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "moe_ffn",
     "shard_moe_params",
     "make_zero_dp_train_step",
+    "zero_clip_by_global_norm",
     "zero_shard_params",
     "zero_unshard_params",
 ]

@@ -104,9 +104,11 @@ def make_zero_dp_train_step(
     make_dp_train_step` up to fp32 reduction order (asserted in
     ``tests/test_zero.py``).
 
-    Caveat: the optax chain runs on LOCAL shards, so transforms needing a
-    global reduction over the whole tree (e.g. ``clip_by_global_norm``)
-    would compute shard-local norms; stick to elementwise transforms here.
+    Caveat: the optax chain runs on LOCAL shards, so a transform needing a
+    global reduction over the whole tree would compute shard-local norms.
+    For global-norm clipping use :func:`zero_clip_by_global_norm` (one psum
+    of shard square-norms makes it exact); other global-reduction
+    transforms need the same treatment before they are safe here.
 
     ``num_microbatches > 1`` adds FSDP-style gradient accumulation: the
     per-device batch is split along its leading dim and scanned — each
@@ -132,10 +134,25 @@ def make_zero_dp_train_step(
         return jax.tree.map(g, shards, shapes, dtypes)
 
     def step(param_shards, opt_state, batch, key):
-        # param-shaped [n, k] leaves are sharded; scalars/counters replicated
-        state_specs = jax.tree.map(
-            lambda l: P(axis) if jnp.ndim(l) == 2 else P(), opt_state
-        )
+        # param-shaped [n, k] leaves are sharded; scalars/counters replicated.
+        # The rank-2 heuristic is validated: any 2-D state leaf whose shape
+        # is not one of the [n, k] shard layouts (e.g. a transform carrying
+        # its own matrix state) would be mis-sharded, so reject it loudly.
+        shard_shapes = {jnp.shape(l) for l in jax.tree.leaves(param_shards)}
+
+        def spec_for(l):
+            if jnp.ndim(l) != 2:
+                return P()
+            if jnp.shape(l) not in shard_shapes:
+                raise ValueError(
+                    f"optimizer state carries a 2-D leaf of shape "
+                    f"{jnp.shape(l)} that matches no [n, k] param shard "
+                    f"{sorted(shard_shapes)}; this optax transform is not "
+                    "supported by the ZeRO sharding heuristic"
+                )
+            return P(axis)
+
+        state_specs = jax.tree.map(spec_for, opt_state)
 
         @partial(
             shard_map,
@@ -207,3 +224,45 @@ def make_zero_dp_train_step(
         return sharded_step(param_shards, opt_state, batch, key)
 
     return jax.jit(step)
+
+
+def zero_clip_by_global_norm(
+    max_norm: float, axis: str = "data"
+) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` made correct on ZeRO's ``[1, k]``
+    local shards (VERDICT r3 directive #4).
+
+    Each device's update leaves hold disjoint rows of the ``[n, k]`` layout,
+    so the true global square-norm is ONE ``lax.psum`` of the shard-local
+    square-norms over the mesh axis (padded tail entries are exactly zero
+    and contribute nothing).  Semantics mirror optax: updates pass through
+    untouched when ``g_norm < max_norm``, else scale by
+    ``max_norm / g_norm`` — so ZeRO + this transform equals replicated DP +
+    ``optax.clip_by_global_norm`` (asserted in ``tests/test_zero.py``).
+
+    Must run inside the optax chain handed to
+    :func:`make_zero_dp_train_step` (the chain executes inside the
+    ``shard_map``, where the axis name is bound).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        local_sq = sum(
+            jnp.sum(jnp.square(u.astype(jnp.float32)))
+            for u in jax.tree.leaves(updates)
+        )
+        g_norm = jnp.sqrt(lax.psum(local_sq, axis))
+        trigger = g_norm < max_norm
+        clipped = jax.tree.map(
+            lambda t: jnp.where(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm
+            ),
+            updates,
+        )
+        return clipped, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -18,6 +18,7 @@ from ddl25spring_tpu.ops.losses import nll_loss
 from ddl25spring_tpu.parallel.dp import make_dp_train_step
 from ddl25spring_tpu.parallel.zero import (
     make_zero_dp_train_step,
+    zero_clip_by_global_norm,
     zero_shard_params,
     zero_unshard_params,
 )
@@ -144,6 +145,69 @@ def test_zero_grad_accum_equals_full_batch(setup, M, devices8):
         jax.device_get(p1),
         jax.device_get(p2),
     )
+
+
+@pytest.mark.parametrize("max_norm", [0.05, 1e4])
+def test_zero_global_norm_clip_equals_replicated(setup, max_norm, devices8):
+    """ZeRO + zero_clip_by_global_norm == replicated DP +
+    optax.clip_by_global_norm, in both regimes (clip triggered with the
+    tiny max_norm; pass-through with the huge one) — VERDICT r3 #4.
+    Three steps so the clipped updates feed back through Adam state."""
+    data, params, loss_fn = setup
+    mesh = make_mesh(devices8[:4], data=4)
+
+    tx_ref = optax.chain(optax.clip_by_global_norm(max_norm), optax.adam(1e-2))
+    tx_z = optax.chain(zero_clip_by_global_norm(max_norm), optax.adam(1e-2))
+
+    dp = make_dp_train_step(loss_fn, tx_ref, mesh, per_shard_rng=False)
+    zero = make_zero_dp_train_step(
+        loss_fn, tx_z, mesh, params, per_shard_rng=False
+    )
+
+    batch = (
+        jnp.asarray(data["x_train"][:64]),
+        jnp.asarray(data["y_train"][:64]),
+    )
+    key = jax.random.PRNGKey(3)
+
+    p_ref, o_ref = params, tx_ref.init(params)
+    for _ in range(3):
+        p_ref, o_ref, _ = dp(p_ref, o_ref, batch, key)
+
+    shards = zero_shard_params(params, mesh)
+    o_z = tx_z.init(shards)
+    for _ in range(3):
+        shards, o_z, _ = zero(shards, o_z, batch, key)
+
+    restored = zero_unshard_params(jax.device_get(shards), params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        jax.device_get(p_ref),
+        restored,
+    )
+
+
+def test_zero_rejects_mismatched_2d_state(setup, devices8):
+    """A transform whose 2-D state leaf is not in the [n, k] shard layout
+    must be rejected loudly, not silently mis-sharded (ADVICE r3)."""
+    _, params, loss_fn = setup
+    mesh = make_mesh(devices8[:2], data=2)
+
+    def bad_init(params):
+        return {"mat": jnp.zeros((3, 7))}
+
+    def bad_update(updates, state, params=None):
+        return updates, state
+
+    tx = optax.GradientTransformation(bad_init, bad_update)
+    step = make_zero_dp_train_step(loss_fn, tx, mesh, params)
+    shards = zero_shard_params(params, mesh)
+    with pytest.raises(ValueError, match="2-D leaf"):
+        step(shards, tx.init(shards),
+             (jnp.zeros((8, 28, 28, 1)), jnp.zeros((8,), jnp.int32)),
+             jax.random.PRNGKey(0))
 
 
 def test_zero_moe_llama_composition(devices8):
