@@ -79,12 +79,17 @@ def make_pipeline_loss(
     internals in the backward — a middle point between plain GPipe (all
     residuals live) and the 1F1B schedule (M-invariant stash,
     :func:`make_1f1b_value_and_grad`).
+
+    Switch-MoE configs (``cfg.n_experts > 0``) ride the pipeline: each
+    stage accumulates its layers' load-balancing aux loss for its ACTIVE
+    forward ticks into the scan carry, weighted by ``cfg.moe_aux_weight``
+    and folded into the returned scalar.  MoE dispatch groups are
+    per-microbatch-per-stage (the flattened ``[mb*L, D]`` the stage sees),
+    so the oracle is the mean over microbatches of
+    ``causal_lm_loss + w * aux`` from
+    :func:`~ddl25spring_tpu.models.llama.llama_forward_with_aux` — asserted
+    in ``tests/test_pipeline.py``.
     """
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the MoE aux loss would be silently dropped here)"
-        )
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
@@ -120,7 +125,20 @@ def make_pipeline_loss(
             # clamp keeps the index static-shaped during drain ticks)
             x_first = llama.embed(head, tokens_mb[jnp.minimum(t, M - 1)], cfg)
             x_in = jnp.where(s == 0, x_first, incoming)
-            x_out = llama.apply_blocks(local_blocks, x_in, cfg)
+            if cfg.n_experts > 0:
+                x_out, aux = llama.apply_blocks(
+                    local_blocks, x_in, cfg, with_aux=True
+                )
+                # stage s works on microbatch t-s; aux from drain-tick
+                # garbage is masked (the weight also zeroes its cotangent)
+                f_idx = t - s
+                w_f = jnp.where(
+                    jnp.logical_and(f_idx >= 0, f_idx < M), 1.0, 0.0
+                ).astype(jnp.float32)
+                aux_term = w_f * jnp.float32(cfg.moe_aux_weight) * aux
+            else:
+                x_out = llama.apply_blocks(local_blocks, x_in, cfg)
+                aux_term = jnp.float32(0.0)
 
             # last stage finishes microbatch t-(S-1) on this tick
             done = t - (S - 1)
@@ -141,7 +159,7 @@ def make_pipeline_loss(
             outgoing = lax.ppermute(
                 x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
             )
-            return (outgoing, loss_sum + loss_mb), None
+            return (outgoing, loss_sum + loss_mb + aux_term), None
 
         carry0 = (
             lax.pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
@@ -208,12 +226,13 @@ def make_1f1b_value_and_grad(
 
     Returns ``f(params, tokens) -> (loss, grads)`` with the same contract as
     ``jax.value_and_grad(make_pipeline_loss(...))``.
+
+    Switch-MoE configs are supported: every stage's local loss carries its
+    layers' weighted aux term (see :func:`make_pipeline_loss`), so the
+    cotangent seed is 1.0 on EVERY stage's loss output, not just the last —
+    for dense configs the non-last loss branch is the constant 0, so the
+    uniform seed leaves their gradients untouched.
     """
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the MoE aux loss would be silently dropped here)"
-        )
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
@@ -252,21 +271,29 @@ def make_1f1b_value_and_grad(
 
         def local_fwd_loss(blocks, hd, x_in, tok):
             """This stage's slice of the model, as one differentiable fn:
-            stage 0 prepends embed, the last stage appends unembed+loss."""
+            stage 0 prepends embed, the last stage appends unembed+loss;
+            MoE stages add their layers' weighted aux loss."""
             x_in = lax.cond(
                 s == 0,
                 lambda x: llama.embed(hd, tok, cfg),
                 lambda x: x,
                 x_in,
             )
-            x_out = llama.apply_blocks(blocks, x_in, cfg)
+            if cfg.n_experts > 0:
+                x_out, aux = llama.apply_blocks(
+                    blocks, x_in, cfg, with_aux=True
+                )
+                aux_term = jnp.float32(cfg.moe_aux_weight) * aux
+            else:
+                x_out = llama.apply_blocks(blocks, x_in, cfg)
+                aux_term = jnp.float32(0.0)
             loss = lax.cond(
                 is_last,
                 lambda x: causal_lm_loss(llama.unembed(hd, x, cfg), tok),
                 lambda x: lax.pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
             )
-            return x_out, loss
+            return x_out, loss + aux_term
 
         def tick(carry, t):
             fwd_in, cot_in, ring, gblocks, ghead, loss_sum = carry
@@ -303,12 +330,12 @@ def make_1f1b_value_and_grad(
                 vblocks, head, x_saved,
             )
             # cotangent seed: downstream cotangent for interior stages, the
-            # scalar loss for the last (its x_out feeds nothing but the loss)
+            # scalar loss for the last (its x_out feeds nothing but the
+            # loss).  The loss seed is 1.0 on EVERY stage: non-last dense
+            # stages output the constant 0 (zero pullback), and MoE stages
+            # need their aux term differentiated
             g_out = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
-            g_loss = jnp.where(
-                is_last, jnp.float32(1.0), jnp.float32(0.0)
-            )
-            g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + g_loss
+            g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + 1.0
             db, dh, dx = pull((g_out.astype(x_out_b.dtype), g_loss))
 
             w = jnp.where(bwd_active, jnp.float32(1.0), jnp.float32(0.0))
@@ -389,11 +416,6 @@ def make_pipeline_train_step(
     interleaved schedule, parity with ``intro_PP_1F1B.py`` generalized to
     M microbatches — see :func:`make_1f1b_value_and_grad`).
     """
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the aux loss would be silently dropped here)"
-        )
     if schedule == "1f1b":
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis
