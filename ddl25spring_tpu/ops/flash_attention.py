@@ -124,7 +124,7 @@ def _fwd_kernel(
 
 def _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret):
     BH, L, hd = q3.shape
-    nq, nk = L // block_q, L // block_k
+    nq, nk = L // block_q, k3.shape[1] // block_k
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_q=block_q, block_k=block_k, nk=nk,
@@ -291,13 +291,13 @@ def _flash_fwd(q3, k3, v3, block_q, block_k, causal, interpret):
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(block_q, block_k, causal, interpret, res, do):
-    q3, k3, v3, o, lse = res
+def _bwd_pallas(q3, k3, v3, o, lse, do, delta, block_q, block_k, causal,
+                interpret):
+    """The two flash backward kernels, shared by the plain VJP and the
+    lse-cotangent VJP (which only adjusts ``delta`` — see ``_flash_lse_bwd``)."""
     BH, L, hd = q3.shape
-    nq, nk = L // block_q, L // block_k
+    nq, nk = L // block_q, k3.shape[1] // block_k
     scale = 1.0 / (hd ** 0.5)
-    # [BH, L, 1] like lse (TPU block-tiling rule, see _fwd_kernel)
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)[..., None]
 
     dq = pl.pallas_call(
         functools.partial(
@@ -352,7 +352,56 @@ def _flash_bwd(block_q, block_k, causal, interpret, res, do):
     return dq, dk, dv
 
 
+def _flash_bwd(block_q, block_k, causal, interpret, res, do):
+    q3, k3, v3, o, lse = res
+    # [BH, L, 1] like lse (TPU block-tiling rule, see _fwd_kernel)
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)[..., None]
+    return _bwd_pallas(
+        q3, k3, v3, o, lse, do, delta, block_q, block_k, causal, interpret
+    )
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------- lse-returning variant
+# (the ring-SP composition needs per-block (o, lse) so ring steps can be
+# merged with the log-sum-exp merge — parallel/sp.py:ring_flash_attention)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q3, k3, v3, block_q, block_k, causal, interpret):
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, lse = _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret)
+    return o, lse[..., 0]
+
+
+def _flash_lse_fwd(q3, k3, v3, block_q, block_k, causal, interpret):
+    scale = 1.0 / (q3.shape[-1] ** 0.5)
+    o, lse = _fwd(q3, k3, v3, block_q, block_k, scale, causal, interpret)
+    return (o, lse[..., 0]), (q3, k3, v3, o, lse)
+
+
+def _flash_lse_bwd(block_q, block_k, causal, interpret, res, cts):
+    """Backward with BOTH cotangents (do, dlse).
+
+    ``ds_ij = p_ij * (dp_ij - delta_i + dlse_i)`` — the lse cotangent
+    enters as ``d lse_i / d s_ij = p_ij``, so it folds into the ``delta``
+    operand of the unchanged kernels (``delta' = delta - dlse``); ``dv``
+    has no lse term (lse is v-independent).
+    """
+    do, dlse = cts
+    q3, k3, v3, o, lse = res
+    delta = (
+        (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+        - dlse.astype(jnp.float32)
+    )[..., None]
+    return _bwd_pallas(
+        q3, k3, v3, o, lse, do, delta, block_q, block_k, causal, interpret
+    )
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(
@@ -384,3 +433,42 @@ def flash_attention(
 
     o3 = _flash(fold(q), fold(k), fold(v), bq, bk, causal, interpret)
     return o3.reshape(B, H, L, hd).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """:func:`flash_attention` also returning the log-sum-exp.
+
+    ``q/k/v``: ``[B, L, H, hd]`` -> ``(o [B, L, H, hd], lse [B, H, L])``.
+    The VJP consumes cotangents for BOTH outputs, so downstream math that
+    mixes o and lse — the ring-step log-sum-exp merge in
+    :func:`ddl25spring_tpu.parallel.sp.ring_flash_attention` — back-
+    propagates exactly.  KV length may differ from L only when
+    ``causal=False`` (the causal finalize index assumes the square
+    diagonal; rectangular-causal would silently never finalize, so it is
+    rejected loudly)."""
+    B, L, H, hd = q.shape
+    Lk = k.shape[1]
+    if causal and Lk != L:
+        raise ValueError(
+            f"causal flash requires square q/kv lengths, got L={L} Lk={Lk}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq, bk = _choose_block(L, block_q), _choose_block(Lk, block_k)
+
+    def fold(x):
+        n = x.shape[1]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, n, hd)
+
+    o3, lse3 = _flash_lse(fold(q), fold(k), fold(v), bq, bk, causal, interpret)
+    o = o3.reshape(B, H, L, hd).transpose(0, 2, 1, 3)
+    return o, lse3.reshape(B, H, L)

@@ -89,6 +89,95 @@ def ring_attention(q, k, v, axis: str, q_pos, kv_pos, dtype):
     return out.astype(dtype)
 
 
+def _dense_attention_with_lse(q, k, v, causal: bool):
+    """``[B, Lq, H, hd] x [B, Lk, H, hd] -> (o fp32 [B, Lq, H, hd],
+    lse [B, H, Lq])`` — the off-TPU stand-in for
+    ``flash_attention_with_lse`` inside ``shard_map`` (the Pallas
+    interpreter cannot execute under VMA-checked shard_map off-TPU, cf.
+    ``models/llama.py:block_forward``)."""
+    hd = q.shape[-1]
+    s = jnp.einsum(
+        "blhd,bmhd->bhlm", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(hd))
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m = s.max(-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(-1)
+    lse = m + jnp.log(l)
+    o = jnp.einsum("bhlm,bmhd->bhld", p / l[..., None], v.astype(jnp.float32))
+    return o.transpose(0, 2, 1, 3), lse
+
+
+def ring_flash_attention(
+    q, k, v, axis: str, dtype, block_q: int = 512, block_k: int = 512
+):
+    """Ring attention with a FLASH local step: SP x flash compose
+    (VERDICT r3 directive #2), so per-shard attention memory is O(Ll·d)
+    and the two long-context features multiply (n-device ``seq`` mesh x
+    32k-per-shard flash = n*32k effective context).
+
+    Requires what :func:`make_sp_loss` guarantees: shard ``s`` holds the
+    CONTIGUOUS positions ``[s*Ll, (s+1)*Ll)``.  Block visibility is then
+    structural, no per-pair masks: ring step 0 is the own block (causal
+    flash); at step ``t > 0`` device ``s`` holds the block of shard
+    ``s - t (mod n)`` — fully visible when ``s >= t``, fully masked
+    otherwise.  Per-step outputs ``(o_t, lse_t)`` fold into the
+    accumulator with the log-sum-exp merge
+    (``o <- (o*e^{lse-m} + o_t*e^{lse_t-m}) / (e^{lse-m}+e^{lse_t-m})``);
+    the lse cotangent this merge needs is exactly what
+    ``flash_attention_with_lse``'s VJP provides.
+
+    On TPU each local step is the fully-blocked Pallas kernel; off-TPU a
+    dense-with-lse fallback keeps the same ring/merge math testable on
+    the CPU mesh.
+    """
+    n = lax.psum(1, axis)
+    s_idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    def attn(qq, kk, vv, causal):
+        if on_tpu:
+            from ddl25spring_tpu.ops.flash_attention import (
+                flash_attention_with_lse,
+            )
+
+            o, lse = flash_attention_with_lse(
+                qq, kk, vv, causal=causal, block_q=block_q, block_k=block_k
+            )
+            return o.astype(jnp.float32), lse.astype(jnp.float32)
+        return _dense_attention_with_lse(qq, kk, vv, causal)
+
+    o_acc, lse_acc = attn(q, k, v, True)  # own block: causal
+    if n == 1:
+        return o_acc.astype(dtype)
+
+    def step(carry, t):
+        k_blk, v_blk, o_acc, lse_acc = carry
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        o_t, lse_t = attn(q, k_blk, v_blk, False)
+        vis = s_idx >= t  # holding shard s-t's block: visible iff s >= t
+        lse_t = jnp.where(vis, lse_t, -jnp.inf)  # masked -> zero weight
+        m = jnp.maximum(lse_acc, lse_t)
+        a = jnp.exp(lse_acc - m)
+        b = jnp.exp(lse_t - m)  # exp(-inf - m) == 0 when masked
+        denom = a + b
+        aw = (a / denom).transpose(0, 2, 1)[..., None]  # [B, Ll, H, 1]
+        bw = (b / denom).transpose(0, 2, 1)[..., None]
+        o_acc = o_acc * aw + o_t * bw
+        lse_acc = m + jnp.log(denom)
+        return (k_blk, v_blk, o_acc, lse_acc), None
+
+    (_, _, o_acc, _), _ = lax.scan(
+        step, (k, v, o_acc, lse_acc), jnp.arange(1, n)
+    )
+    return o_acc.astype(dtype)
+
+
 def make_sp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -98,12 +187,13 @@ def make_sp_loss(
     """``loss(params, tokens) -> scalar``: full llama forward with tokens
     sharded ``[B, L/n]`` over ``seq_axis`` and ring attention in every block.
     Matches :func:`~ddl25spring_tpu.models.llama.llama_forward` + causal-LM
-    loss on the unsharded model."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the MoE aux loss would be silently dropped here)"
-        )
+    loss on the unsharded model.
+
+    Switch-MoE configs are supported: each shard's blocks dispatch over the
+    LOCAL ``[B*L/n, D]`` token group and the weighted aux loss is the
+    ``pmean`` of per-shard switch losses — the standard sharded-MoE
+    estimator (same note as :mod:`ddl25spring_tpu.parallel.ep`), so it is
+    not bitwise the unsharded aux under overflow."""
     n = mesh.shape[seq_axis]
 
     @partial(
@@ -119,13 +209,25 @@ def make_sp_loss(
         offset = lax.axis_index(seq_axis) * Ll
         pos = offset + jnp.arange(Ll)
 
-        attn = partial(ring_attention, axis=seq_axis, q_pos=pos, kv_pos=pos)
+        if cfg.use_flash:
+            # flash local step + lse merge: O(Ll·d) per-shard attention
+            def attn(q, k, v, dtype):
+                return ring_flash_attention(q, k, v, seq_axis, dtype)
+        else:
+            attn = partial(
+                ring_attention, axis=seq_axis, q_pos=pos, kv_pos=pos
+            )
         x = llama.embed(vparams, tokens, cfg)
         x = llama.apply_blocks(
             vparams["blocks"], x, cfg,
+            with_aux=cfg.n_experts > 0,
             pos=pos,
             attn_fn=lambda q, k, v, dtype: attn(q, k, v, dtype=dtype),
         )
+        if cfg.n_experts > 0:
+            x, moe_aux = x
+        else:
+            moe_aux = jnp.float32(0.0)
         logits = llama.unembed(vparams, x, cfg)  # [B, Ll, V] fp32
 
         # boundary target: next shard's first token (one-token ppermute)
@@ -144,6 +246,10 @@ def make_sp_loss(
         local_sum = -(picked * valid).sum()
         local_cnt = (valid * jnp.ones((B, 1))).sum()
         total = lax.psum(local_sum, seq_axis) / lax.psum(local_cnt, seq_axis)
+        if cfg.n_experts > 0:
+            total = total + jnp.float32(cfg.moe_aux_weight) * lax.pmean(
+                moe_aux, seq_axis
+            )
         if data_axis is not None:
             total = lax.pmean(total, data_axis)
         return total
@@ -159,11 +265,6 @@ def make_sp_train_step(
     data_axis: str | None = None,
 ):
     """Jitted SP(xDP) train step (params replicated, tokens seq-sharded)."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the aux loss would be silently dropped here)"
-        )
     loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis)
 
     @jax.jit

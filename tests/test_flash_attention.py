@@ -118,6 +118,47 @@ def test_flash_grads_match_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_with_lse_matches_dense(causal):
+    """The (o, lse) variant: value AND the joint (do, dlse) backward —
+    the ring-SP merge consumes lse, so its cotangent path (ds gains a
+    ``p * dlse`` term, folded into delta) must match dense autodiff."""
+    from ddl25spring_tpu.ops.flash_attention import flash_attention_with_lse
+    from ddl25spring_tpu.parallel.sp import _dense_attention_with_lse
+
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv, kt, ks = jax.random.split(key, 5)
+    shape = (2, 128, 2, 32)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    t_o = jax.random.normal(kt, shape, jnp.float32)
+    t_l = jax.random.normal(ks, (2, 2, 128), jnp.float32)
+
+    o_f, lse_f = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+    )
+    o_d, lse_d = _dense_attention_with_lse(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse_f), np.asarray(lse_d), atol=2e-5)
+
+    # a loss mixing BOTH outputs (like the ring lse merge does)
+    def f_flash(q, k, v):
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+        )
+        return (o * t_o).sum() + (jnp.tanh(lse) * t_l).sum()
+
+    def f_dense(q, k, v):
+        o, lse = _dense_attention_with_lse(q, k, v, causal)
+        return (o * t_o).sum() + (jnp.tanh(lse) * t_l).sum()
+
+    g_f = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
 def test_llama_forward_with_flash_matches_dense_path():
     cfg_d = LlamaConfig(
         vocab_size=64, dmodel=64, num_heads=2, n_layers=2, ctx_size=128,

@@ -60,6 +60,72 @@ def test_sp_grads_equal_serial(params_and_tokens, devices8):
     )
 
 
+FLASH_CFG = LlamaConfig(
+    vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=32,
+    dtype="float32", use_flash=True,
+)
+
+
+@pytest.mark.parametrize("ring", [2, 4])
+def test_ring_flash_loss_and_grads_equal_serial(
+    params_and_tokens, ring, devices8
+):
+    """SP x flash composition (VERDICT r3 #2): the flash-local-step ring
+    (lse merge, structural visibility) must match the dense ring AND the
+    serial model — values and grads.  Off-TPU the local step is the
+    dense-with-lse fallback, so this pins the ring/merge math and its
+    backward; the Pallas (o, lse) kernel itself is pinned in
+    test_flash_attention.py."""
+    params, tokens = params_and_tokens
+    mesh = make_mesh(devices8[:ring], seq=ring)
+    loss_flash = make_sp_loss(FLASH_CFG, mesh)
+    loss_dense = make_sp_loss(CFG, mesh)
+
+    lf = float(jax.jit(loss_flash)(params, tokens))
+    np.testing.assert_allclose(lf, float(serial_loss(params, tokens)), rtol=1e-5)
+    np.testing.assert_allclose(
+        lf, float(jax.jit(loss_dense)(params, tokens)), rtol=1e-5
+    )
+
+    g_flash = jax.jit(jax.grad(loss_flash))(params, tokens)
+    g_serial = jax.grad(serial_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        g_flash,
+        g_serial,
+    )
+
+
+def test_sp_moe_aux_reaches_loss(devices8):
+    """MoE under SP: the per-shard switch aux must appear in the loss (no
+    silent drop) — with one shard the dispatch group is the full batch, so
+    the value matches the serial composite exactly."""
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=32,
+        dtype="float32", n_experts=4, capacity_factor=2.0,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(3), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 32), 0, 64)
+
+    mesh1 = make_mesh(devices8[:1], seq=1)
+    l_sp = float(jax.jit(make_sp_loss(cfg, mesh1))(params, tokens))
+    logits, aux = llama.llama_forward_with_aux(params, tokens, cfg)
+    l_serial = float(
+        causal_lm_loss(logits, tokens) + cfg.moe_aux_weight * aux
+    )
+    np.testing.assert_allclose(l_sp, l_serial, rtol=1e-5)
+    assert float(aux) > 0.0  # the aux term is genuinely nonzero
+
+    # 2-shard ring: per-shard dispatch estimator — runs, finite, and close
+    # to serial (estimator, not bitwise; see module docstring)
+    mesh2 = make_mesh(devices8[:2], seq=2)
+    l_sp2 = float(jax.jit(make_sp_loss(cfg, mesh2))(params, tokens))
+    assert np.isfinite(l_sp2)
+    np.testing.assert_allclose(l_sp2, l_serial, rtol=0.05)
+
+
 def test_sp_dp_train_step(params_and_tokens, devices8):
     """(data=2, seq=4): one step matches the serial step on the same batch."""
     params, tokens = params_and_tokens
