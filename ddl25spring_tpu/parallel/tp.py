@@ -11,7 +11,14 @@ Layout (the standard column/row split):
 - column-sharded (output dim): ``wq``, ``wk``, ``wv`` (head dim — heads
   divide over the axis), ``w_gate``, ``w_up``;
 - row-sharded (input dim): ``wo``, ``w_down`` — partial products psum'd;
-- replicated: embed, norms, unembed (small at this model scale).
+- replicated: embed, norms;
+- ``unembed`` is VOCAB-SHARDED by default (``shard_vocab=True``): each
+  device projects to its ``V/n`` logit slice and the causal-LM loss is
+  assembled from per-shard log-sum-exps (one ``all_gather`` of ``[B, L]``
+  scalars + one ``psum``; see :func:`vocab_sharded_lm_loss`) — the full
+  ``[B, L, V]`` logits never materialize on any device, so the TP layout
+  keeps scaling at production vocab sizes (the Megatron
+  parallel-cross-entropy recipe).
 
 Composes with DP on a 2-D ``(data, model)`` mesh: the batch shards over
 ``data``, grads psum over ``data`` automatically (invariant params), and each
@@ -40,7 +47,9 @@ _COL = ("wq", "wk", "wv", "w_gate", "w_up")  # shard output (last) dim
 _ROW = ("wo", "w_down")                      # shard input (first of 2) dims
 
 
-def tp_param_specs(model_axis: str = "model") -> Params:
+def tp_param_specs(
+    model_axis: str = "model", shard_vocab: bool = True
+) -> Params:
     """PartitionSpecs for the llama pytree under TP.  Blocks are stacked
     ``[L, ...]`` so the weight dims shift right by one."""
     block = {
@@ -48,12 +57,22 @@ def tp_param_specs(model_axis: str = "model") -> Params:
         **{k: P(None, None, model_axis) for k in _COL},
         **{k: P(None, model_axis, None) for k in _ROW},
     }
-    return {"embed": P(), "blocks": block, "ln_f": P(), "unembed": P()}
+    return {
+        "embed": P(),
+        "blocks": block,
+        "ln_f": P(),
+        "unembed": P(None, model_axis) if shard_vocab else P(),
+    }
 
 
-def shard_tp_params(params: Params, mesh: Mesh, model_axis: str = "model"):
+def shard_tp_params(
+    params: Params,
+    mesh: Mesh,
+    model_axis: str = "model",
+    shard_vocab: bool = True,
+):
     """Place llama params on the mesh with the TP layout."""
-    specs = tp_param_specs(model_axis)
+    specs = tp_param_specs(model_axis, shard_vocab)
     shardings = {
         "embed": NamedSharding(mesh, specs["embed"]),
         "blocks": {
@@ -66,26 +85,59 @@ def shard_tp_params(params: Params, mesh: Mesh, model_axis: str = "model"):
     return jax.device_put(params, shardings)
 
 
+def vocab_sharded_lm_loss(
+    logits: jax.Array, tokens: jax.Array, axis: str
+) -> jax.Array:
+    """:func:`~ddl25spring_tpu.ops.losses.causal_lm_loss` over a
+    vocab-sharded logits slice ``[B, L, V/n]`` (inside ``shard_map``).
+
+    The log-partition and the picked target logit are assembled from the
+    shards with one ``all_gather`` + one ``psum`` over ``[B, L]`` arrays —
+    communication O(B*L*n), independent of V.  (The per-shard lse is
+    computed locally, then combined over the gathered device axis: both
+    collectives are differentiable, unlike ``pmax``.)"""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    Vl = logits.shape[-1]
+    off = lax.axis_index(axis) * Vl
+    lse_loc = jax.scipy.special.logsumexp(logits, axis=-1)   # [B, L-1]
+    lse_all = lax.all_gather(lse_loc, axis)                  # [n, B, L-1]
+    logz = jax.scipy.special.logsumexp(lse_all, axis=0)
+    t_local = jnp.clip(targets - off, 0, Vl - 1)
+    picked_l = jnp.take_along_axis(logits, t_local[..., None], -1)[..., 0]
+    mine = (targets >= off) & (targets < off + Vl)
+    picked = lax.psum(jnp.where(mine, picked_l, 0.0), axis)
+    # all_gather output is VMA-varying though every device holds the same
+    # values; the pmean re-types the (already identical) scalar invariant
+    return lax.pmean((logz - picked).mean(), axis)
+
+
 def make_tp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
     model_axis: str = "model",
     data_axis: str | None = None,
+    shard_vocab: bool = True,
 ):
     """``loss(params, tokens) -> scalar`` with TP(xDP) sharded blocks."""
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(tp_param_specs(model_axis), P(data_axis)),
+        in_specs=(tp_param_specs(model_axis, shard_vocab), P(data_axis)),
         out_specs=P(),
     )
     def tp_loss(params: Params, tokens: jax.Array) -> jax.Array:
         local_blocks = params["blocks"]
         x = llama.embed(params, tokens, cfg)
         x = llama.apply_blocks(local_blocks, x, cfg, tp_axis=model_axis)
+        # under shard_vocab, params["unembed"] is the local [D, V/n] slice,
+        # so llama.unembed emits this device's logit columns unchanged
         logits = llama.unembed(params, x, cfg)
-        loss = causal_lm_loss(logits, tokens)
+        if shard_vocab:
+            loss = vocab_sharded_lm_loss(logits, tokens, model_axis)
+        else:
+            loss = causal_lm_loss(logits, tokens)
         if data_axis is not None:
             loss = lax.pmean(loss, data_axis)
         return loss
@@ -99,14 +151,16 @@ def make_tp_train_step(
     mesh: Mesh,
     model_axis: str = "model",
     data_axis: str | None = None,
+    shard_vocab: bool = True,
 ):
     """Jitted TP(xDP) train step; params stay sharded across steps."""
     if cfg.n_experts > 0:
         raise NotImplementedError(
             "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the aux loss would be silently dropped here)"
+            "(the aux loss would be silently dropped here; TP param specs "
+            "do not cover the moe subtree)"
         )
-    loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis)
+    loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis, shard_vocab)
 
     @jax.jit
     def step(params, opt_state, tokens):
