@@ -88,21 +88,32 @@ def _dispatch_tensors(router_logits: jax.Array, capacity: int):
     # under-penalize imbalance exactly when drops occur
     frac = onehot.sum(0) / jnp.maximum(onehot.sum(), 1.0)
     aux = E * jnp.sum(frac * probs.mean(0))
-    return disp, combine, aux
+    # kept-token count per expert [E] (dropped = assigned - kept): the
+    # overflow accounting the EP/dense equivalence tests pin
+    kept = keep.sum(0)
+    return disp, combine, aux, kept
 
 
 def moe_ffn(
-    p: Params, x: jax.Array, capacity_factor: float = 1.25
-) -> tuple[jax.Array, jax.Array]:
-    """Single-device reference MoE: ``x [T, D] -> ([T, D], aux_loss)``."""
+    p: Params,
+    x: jax.Array,
+    capacity_factor: float = 1.25,
+    return_stats: bool = False,
+):
+    """Single-device reference MoE: ``x [T, D] -> ([T, D], aux_loss)``.
+
+    ``return_stats=True`` appends ``{"kept": [E], "assigned": T}`` so
+    callers can account dropped tokens (``T - kept.sum()``)."""
     T, D = x.shape
     E = p["router"].shape[1]
     C = max(1, int(T * capacity_factor / E))
     logits = x.astype(jnp.float32) @ p["router"]
-    disp, combine, aux = _dispatch_tensors(logits, C)
+    disp, combine, aux, kept = _dispatch_tensors(logits, C)
     expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
     expert_out = _expert_ffn(p, expert_in)
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    if return_stats:
+        return y, aux, {"kept": kept, "assigned": jnp.float32(T)}
     return y, aux
 
 
@@ -110,6 +121,7 @@ def make_ep_moe_fn(
     mesh: Mesh,
     axis: str = "expert",
     capacity_factor: float = 1.25,
+    return_stats: bool = False,
 ):
     """EP-sharded MoE: tokens AND experts sharded over ``mesh[axis]``.
 
@@ -118,6 +130,11 @@ def make_ep_moe_fn(
     Per shard: local dispatch to all E experts -> ``all_to_all`` so each
     device holds its local experts' buckets from every shard -> batched
     expert FFN -> ``all_to_all`` back -> local combine.
+
+    ``return_stats=True`` appends ``{"kept": [E], "assigned": T_global}``
+    (psum over shards).  Because each shard dispatches its own token group
+    with capacity ``T_local*cf/E``, the kept counts equal the dense
+    :func:`moe_ffn` run per shard group — pinned in ``tests/test_ep.py``.
     """
     ep = mesh.shape[axis]
 
@@ -132,7 +149,7 @@ def make_ep_moe_fn(
         shard_map,
         mesh=mesh,
         in_specs=(param_specs, P(axis)),
-        out_specs=(P(axis), P()),
+        out_specs=(P(axis), P(), P()) if return_stats else (P(axis), P()),
     )
     def f(p: Params, x: jax.Array):
         T_local, D = x.shape
@@ -141,7 +158,7 @@ def make_ep_moe_fn(
         C = max(1, int(T_local * capacity_factor / E))
         router = lax.pcast(p["router"], axis, to="varying")
         logits = x.astype(jnp.float32) @ router
-        disp, combine, aux = _dispatch_tensors(logits, C)
+        disp, combine, aux, kept = _dispatch_tensors(logits, C)
 
         expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
         # regroup [E, C, D] = [ep, E_local, C, D]: hand shard s's buckets
@@ -165,6 +182,12 @@ def make_ep_moe_fn(
         # shard) — the standard sharded-MoE estimator; it converges to the
         # global loss but is not bitwise equal to it (product of means !=
         # mean of products)
+        if return_stats:
+            stats = {
+                "kept": lax.psum(kept, axis),
+                "assigned": jnp.float32(T_local * ep),  # equal-size shards
+            }
+            return y, lax.pmean(aux, axis), stats
         return y, lax.pmean(aux, axis)
 
     return f

@@ -93,6 +93,39 @@ def test_capacity_overflow_drops_tokens(setup):
     )
 
 
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_drop_accounting_matches_dense(setup, ep, devices8):
+    """Under OVERFLOW with an unequal routing load, the EP path's
+    kept/dropped accounting must equal the dense reference applied to each
+    shard's token group (per-shard buckets are the documented EP
+    semantics) — VERDICT r3 #10."""
+    p, x = setup
+    cf = 0.5  # tight capacity: forces drops
+    # the natural routing load is unequal (precondition of the test)
+    counts = np.bincount(
+        np.asarray((x @ p["router"]).argmax(-1)), minlength=E
+    )
+    assert counts.max() > counts.min()
+
+    mesh = make_mesh(devices8[:ep], expert=ep)
+    f = make_ep_moe_fn(mesh, capacity_factor=cf, return_stats=True)
+    y_ep, _, stats = jax.jit(f)(shard_moe_params(p, mesh), x)
+    kept_ep = np.asarray(stats["kept"])
+    assert float(stats["assigned"]) == T
+
+    kept_ref = np.zeros(E, np.float32)
+    for sx in x.reshape(ep, T // ep, D):  # P(axis) shards contiguously
+        _, _, st = moe_ffn(p, sx, capacity_factor=cf, return_stats=True)
+        kept_ref += np.asarray(st["kept"])
+    np.testing.assert_allclose(kept_ep, kept_ref)
+
+    dropped = T - kept_ep.sum()
+    assert dropped > 0, "tight capacity must actually overflow"
+    # dropped tokens pass through as zero rows of y — the counts agree
+    zero_rows = np.asarray(jnp.all(y_ep == 0.0, axis=-1)).sum()
+    assert zero_rows == dropped
+
+
 def test_moe_trains(setup, devices8):
     p, x = setup
     mesh = make_mesh(devices8[:2], expert=2)
