@@ -70,7 +70,9 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 
 def make_hybrid_mesh(
-    dcn_axes: dict[str, int] | None = None, **ici_axes: int
+    dcn_axes: dict[str, int] | None = None,
+    force_slices: int | None = None,
+    **ici_axes: int,
 ) -> Mesh:
     """Multi-host mesh: ``dcn_axes`` laid over the slow inter-slice network,
     ``ici_axes`` over the fast in-slice interconnect.
@@ -87,9 +89,47 @@ def make_hybrid_mesh(
         mesh = make_hybrid_mesh({"data": n_slices}, stage=4, model=2)
 
     Falls back to a flat :func:`make_mesh` in single-process settings (CPU
-    simulation / one host) where there is no slice structure to respect.
+    simulation / one host) where there is no slice structure to respect —
+    unless ``force_slices`` is given, which SIMULATES an n-slice topology
+    by treating contiguous groups of ``len(devices)/force_slices`` devices
+    as slices (dcn axes outermost, exactly the layout
+    ``create_hybrid_device_mesh`` would produce).  That lets the CPU mesh
+    exercise the DP-over-DCN x PP-over-ICI program (dryrun + tests)
+    without multi-host hardware.
     """
     dcn_axes = dict(dcn_axes or {})
+    if force_slices is not None and jax.process_count() == 1:
+        devices = jax.devices()
+        if len(devices) % force_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into "
+                f"{force_slices} simulated slices"
+            )
+        per_slice = len(devices) // force_slices
+        if not dcn_axes:
+            dcn_axes = {"data": force_slices}
+        names = tuple(dcn_axes) + tuple(ici_axes)
+        sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+        if math.prod(dcn_axes.values()) != force_slices:
+            raise ValueError(
+                f"DCN axes {dcn_axes} must tile the {force_slices} "
+                "simulated slices exactly"
+            )
+        if math.prod(ici_axes.values() or [1]) > per_slice:
+            raise ValueError(
+                f"ICI axes {ici_axes} need more than the {per_slice} "
+                "devices per simulated slice"
+            )
+        # contiguous per_slice-blocks are "slices": outer (dcn) dims index
+        # the slice, inner (ici) dims index within it — select WITHIN each
+        # block so a partial ici footprint never leaks across slice bounds
+        ici_total = math.prod(ici_axes.values() or [1])
+        grid = (
+            np.asarray(devices)
+            .reshape(force_slices, per_slice)[:, :ici_total]
+            .reshape(sizes)
+        )
+        return Mesh(grid, axis_names=names)
     if jax.process_count() == 1:
         return make_mesh(None, **dcn_axes, **ici_axes)
     from jax.experimental import mesh_utils
