@@ -7,9 +7,23 @@ never runs on TPU (SURVEY §2), so the in-tree default is a dependency-free
 byte-level tokenizer with the same API surface (``vocab_size``, ``pad_id``,
 ``encode``/``decode``); a SentencePiece wrapper is provided when the package
 is importable.
+
+Because this image ships NEITHER the sentencepiece package nor a model
+artifact, the trained-subword capability (the thing SPTokenizer actually
+adds over bytes) is covered by :class:`BpeTokenizer` — a dependency-free
+byte-level BPE that is TRAINED on a corpus, serialized to a JSON artifact,
+and auto-discovered by :func:`get_tokenizer` exactly like an SP model file
+would be (``DDL25_SP_MODEL`` / ``DDL25_BPE_MODEL`` env vars, then
+``data/*.model`` / ``data/bpe.json``).  Exercised end-to-end (train ->
+save -> load -> encode -> LLaMA train step) in ``tests/test_text_data.py``.
 """
 
 from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
 
 import numpy as np
 
@@ -56,7 +70,132 @@ class SentencePieceTokenizer:
         return self._sp.decode(np.asarray(ids).tolist())
 
 
+class BpeTokenizer:
+    """Byte-level BPE, trainable and serializable, zero dependencies.
+
+    The in-tree replacement for the trained-subword capability of the
+    reference's SentencePiece path (``lab/s01_b1_microbatches.py:6,31``):
+    merges are LEARNED from a corpus (greedy most-frequent-pair, the
+    standard BPE recipe), stored as a JSON artifact, and reloaded by id.
+    Id space: 0/1/2 = pad/bos/eos, 3..258 = bytes, 259+i = merge i.
+
+    Round-trip exactness: text is chunked by ``\\s*\\S+`` (whitespace
+    travels with the following word), merges never cross chunk bounds,
+    and decode is plain byte expansion — so ``decode(encode(t)) == t``
+    for any text.
+    """
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    _BYTE0 = 3  # id of byte 0
+
+    def __init__(self, merges: list[tuple[int, int]]):
+        self.merges = [tuple(m) for m in merges]
+        self._rank = {m: i for i, m in enumerate(self.merges)}
+        self.vocab_size = 256 + self._BYTE0 + len(self.merges)
+        # id -> bytes expansion table
+        self._bytes: dict[int, bytes] = {
+            self._BYTE0 + b: bytes([b]) for b in range(256)
+        }
+        for i, (a, b) in enumerate(self.merges):
+            self._bytes[259 + i] = self._bytes[a] + self._bytes[b]
+
+    # ------------------------------------------------------------ training
+    @classmethod
+    def train(cls, corpus: str, n_merges: int = 512) -> "BpeTokenizer":
+        """Greedy BPE: repeatedly merge the most frequent adjacent id pair
+        over the chunked corpus (counts weighted by chunk frequency)."""
+        words: dict[tuple[int, ...], int] = {}
+        for chunk in re.findall(r"\s*\S+", corpus):
+            ids = tuple(cls._BYTE0 + b for b in chunk.encode("utf-8"))
+            words[ids] = words.get(ids, 0) + 1
+        merges: list[tuple[int, int]] = []
+        for _ in range(n_merges):
+            pairs: dict[tuple[int, int], int] = {}
+            for ids, cnt in words.items():
+                for pair in zip(ids, ids[1:]):
+                    pairs[pair] = pairs.get(pair, 0) + cnt
+            if not pairs:
+                break
+            best = max(pairs, key=pairs.get)
+            if pairs[best] < 2:
+                break
+            new_id = 259 + len(merges)
+            merges.append(best)
+            words = {
+                cls._apply_one(ids, best, new_id): cnt
+                for ids, cnt in words.items()
+            }
+        return cls(merges)
+
+    @staticmethod
+    def _apply_one(ids, pair, new_id):
+        out, i = [], 0
+        while i < len(ids):
+            if i + 1 < len(ids) and (ids[i], ids[i + 1]) == pair:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(ids[i])
+                i += 1
+        return tuple(out)
+
+    # ---------------------------------------------------------- save/load
+    def save(self, path: str) -> None:
+        Path(path).write_text(
+            json.dumps({"format": "ddl25-bpe-v1", "merges": self.merges})
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "BpeTokenizer":
+        obj = json.loads(Path(path).read_text())
+        if obj.get("format") != "ddl25-bpe-v1":
+            raise ValueError(f"{path}: not a ddl25-bpe-v1 artifact")
+        return cls([tuple(m) for m in obj["merges"]])
+
+    # ------------------------------------------------------- encode/decode
+    def _encode_chunk(self, chunk: bytes) -> list[int]:
+        ids = [self._BYTE0 + b for b in chunk]
+        while len(ids) > 1:
+            ranked = [
+                (self._rank.get(p, len(self.merges)), j)
+                for j, p in enumerate(zip(ids, ids[1:]))
+            ]
+            r, j = min(ranked)
+            if r == len(self.merges):
+                break
+            ids[j : j + 2] = [259 + r]
+        return ids
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [self.bos_id] if add_bos else []
+        for chunk in re.findall(r"\s*\S+|\s+$", text):
+            ids.extend(self._encode_chunk(chunk.encode("utf-8")))
+        return ids
+
+    def decode(self, ids) -> str:
+        out = b"".join(
+            self._bytes[i]
+            for i in np.asarray(ids).tolist()
+            if i >= self._BYTE0
+        )
+        return out.decode("utf-8", errors="replace")
+
+
 def get_tokenizer(model_path: str | None = None):
+    """Tokenizer resolution, mirroring the reference's artifact discovery
+    (SPTokenizer loads a fetched model file): an explicit path wins; then
+    env-var/conventional-path artifacts (real SentencePiece model, then
+    the in-tree BPE artifact); else the byte tokenizer."""
     if model_path is not None:
+        if model_path.endswith(".json"):
+            return BpeTokenizer.load(model_path)
         return SentencePieceTokenizer(model_path)
+    sp = os.environ.get("DDL25_SP_MODEL")
+    if sp and Path(sp).exists():
+        return SentencePieceTokenizer(sp)
+    bpe = os.environ.get("DDL25_BPE_MODEL", "data/bpe.json")
+    if bpe and Path(bpe).exists():
+        return BpeTokenizer.load(bpe)
     return ByteTokenizer()

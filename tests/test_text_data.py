@@ -1,9 +1,14 @@
 """Tokenizer + TinyStories stream tests."""
 
 import numpy as np
+import pytest
 
 from ddl25spring_tpu.data.tinystories import TinyStories, generate_story
-from ddl25spring_tpu.data.tokenizer import ByteTokenizer
+from ddl25spring_tpu.data.tokenizer import (
+    BpeTokenizer,
+    ByteTokenizer,
+    get_tokenizer,
+)
 
 
 def test_byte_tokenizer_roundtrip():
@@ -28,6 +33,95 @@ def test_tinystories_batch_shape_and_determinism():
     a, b = next(ds_a), next(ds_b)
     assert a.shape == (3, 64) and a.dtype == np.int32
     np.testing.assert_array_equal(a, b)
+
+
+def _train_corpus(n_stories=400, seed=7):
+    rng = np.random.default_rng(seed)
+    return " ".join(generate_story(rng) for _ in range(n_stories))
+
+
+def test_bpe_trains_compresses_roundtrips(tmp_path):
+    """The trained-subword path end-to-end (VERDICT r3 #6, adapted: the
+    sentencepiece package is absent on this image, so the in-tree BPE
+    covers the capability): train on the corpus -> merges actually learned
+    -> encoding is SHORTER than bytes -> artifact save/load preserves
+    behavior -> exact round-trip incl. unicode."""
+    corpus = _train_corpus()
+    tok = BpeTokenizer.train(corpus, n_merges=256)
+    assert len(tok.merges) > 50  # the corpus supports real merges
+    assert tok.vocab_size == 259 + len(tok.merges)
+
+    text = "One day Tom went to the park. The cat found a red ball."
+    ids = tok.encode(text)
+    byte_len = len(ByteTokenizer().encode(text))
+    assert len(ids) < 0.7 * byte_len  # genuine subword compression
+    assert tok.decode(ids) == text
+
+    weird = "Tabs\tand  spaces Ünïcòde \n newlines"
+    assert tok.decode(tok.encode(weird)) == weird
+
+    path = tmp_path / "bpe.json"
+    tok.save(str(path))
+    tok2 = BpeTokenizer.load(str(path))
+    assert tok2.encode(text) == ids
+    assert tok2.vocab_size == tok.vocab_size
+
+
+def test_get_tokenizer_discovers_bpe_artifact(tmp_path, monkeypatch):
+    """get_tokenizer() artifact discovery mirrors the reference's fetched
+    SPTokenizer model file (s01_b1_microbatches.py:31)."""
+    tok = BpeTokenizer.train(_train_corpus(100), n_merges=64)
+    path = tmp_path / "bpe.json"
+    tok.save(str(path))
+    monkeypatch.setenv("DDL25_BPE_MODEL", str(path))
+    found = get_tokenizer()
+    assert isinstance(found, BpeTokenizer)
+    assert found.vocab_size == tok.vocab_size
+    monkeypatch.delenv("DDL25_BPE_MODEL")
+    monkeypatch.setenv("DDL25_BPE_MODEL", "")
+    assert isinstance(get_tokenizer(), ByteTokenizer)
+    # explicit .json path routes to the BPE loader
+    assert isinstance(get_tokenizer(str(path)), BpeTokenizer)
+
+
+def test_bpe_feeds_tinystories_and_trainstep(tmp_path):
+    """The full b1 mechanism on the trained tokenizer: TinyStories batches
+    under the BPE vocab -> one LLaMA train step, loss finite and falling
+    over a few steps (the reference's convergence-by-eyeball check)."""
+    import jax
+    import optax
+
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    tok = BpeTokenizer.train(_train_corpus(), n_merges=128)
+    ds = iter(TinyStories(tok, batch_size=4, seq_l=32, min_chars=50_000))
+    batch = next(ds)
+    assert batch.max() < tok.vocab_size
+
+    cfg = LlamaConfig(
+        vocab_size=tok.vocab_size, dmodel=32, num_heads=2, n_layers=2,
+        ctx_size=32, dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, toks):
+        def loss_fn(p):
+            return causal_lm_loss(llama.llama_forward(p, toks, cfg), toks)
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    losses = []
+    for _ in range(10):
+        params, opt, loss = step(params, opt, jax.numpy.asarray(next(ds)))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
 
 
 def test_tinystories_skip_disjoint_and_oversized_skip():
