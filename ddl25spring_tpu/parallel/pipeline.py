@@ -189,6 +189,7 @@ def make_1f1b_value_and_grad(
     num_microbatches: int,
     stage_axis: str = "stage",
     data_axis: str | None = None,
+    stash: str = "input",
 ):
     """1F1B: the memory-bounded pipeline schedule, hand-rolled backward.
 
@@ -232,7 +233,23 @@ def make_1f1b_value_and_grad(
     cotangent seed is 1.0 on EVERY stage's loss output, not just the last —
     for dense configs the non-last loss branch is the constant 0, so the
     uniform seed leaves their gradients untouched.
+
+    ``stash`` selects the memory/FLOPs point of the backward:
+
+    - ``"input"`` (default): ring-stash only the stage INPUT; the backward
+      tick recomputes the stage forward under ``jax.vjp`` (remat — one
+      extra stage-forward per microbatch);
+    - ``"residuals"``: the production-standard non-remat 1F1B.  The
+      forward slot runs the stage under ``jax.vjp`` and ring-stashes the
+      pullback's RESIDUAL arrays (hoisted out of the closure with
+      ``jax.closure_convert``); the backward tick replays the converted
+      pullback on the stashed residuals — no recompute, at
+      ``(2S-1) x |stage residuals|`` memory.  The ring is initialized from
+      a valid example trace (not zeros) so drain-tick replays stay finite
+      before the ``w = 0`` mask zeroes them.
     """
+    if stash not in ("input", "residuals"):
+        raise ValueError(f"stash must be 'input' or 'residuals', got {stash!r}")
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
@@ -269,16 +286,19 @@ def make_1f1b_value_and_grad(
 
         is_last = s == S - 1
 
-        def local_fwd_loss(blocks, hd, x_in, tok):
+        def local_fwd_loss(blocks, hd, x_in, tok, embed_in=True):
             """This stage's slice of the model, as one differentiable fn:
-            stage 0 prepends embed, the last stage appends unembed+loss;
-            MoE stages add their layers' weighted aux loss."""
-            x_in = lax.cond(
-                s == 0,
-                lambda x: llama.embed(hd, tok, cfg),
-                lambda x: x,
-                x_in,
-            )
+            stage 0 prepends embed (``embed_in=True``), the last stage
+            appends unembed+loss; MoE stages add their layers' weighted aux
+            loss.  The residual-stash path passes ``embed_in=False`` and
+            handles the embed outside — see the closure_convert note there."""
+            if embed_in:
+                x_in = lax.cond(
+                    s == 0,
+                    lambda x: llama.embed(hd, tok, cfg),
+                    lambda x: x,
+                    x_in,
+                )
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
                     blocks, x_in, cfg, with_aux=True
@@ -358,18 +378,134 @@ def make_1f1b_value_and_grad(
                 axes, to="varying",
             )
 
-        carry0 = (
-            vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # fwd act
-            vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # cotangent
-            vzeros(jnp.empty((K + 1, mb, L, cfg.dmodel)), dtype),  # stash
+        gzero = (
             jax.tree.map(lambda x: vzeros(x, jnp.float32), local_blocks),
             jax.tree.map(lambda x: vzeros(x, jnp.float32), head),
-            lax.pcast(jnp.float32(0.0), axes, to="varying"),
         )
         T = M + 2 * (S - 1)
-        (_, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
-            tick, carry0, jnp.arange(T)
-        )
+
+        if stash == "residuals":
+            # One example trace of the stage vjp: closure_convert hoists
+            # the pullback's closed-over residuals into an explicit array
+            # list (its design use), giving the ring element shapes.
+            #
+            # CAVEAT that shapes this path: closure_convert hoists only
+            # consts on the PERTURBED (differentiable) path; the integer
+            # token batch stays baked in the converted callable's closure,
+            # i.e. a replay would read the REPLAYING tick's tokens.  The
+            # last stage is immune (its backward is same-tick, f_idx ==
+            # b_idx, and it is the only consumer of the CE targets), but
+            # stage 0's embed-gather indices would be 2(S-1) ticks stale.
+            # So the embed runs OUTSIDE the vjp (embed_in=False), tokens
+            # get their own int ring, and the embed gradient is formed
+            # explicitly at the backward slot: a scatter-add of the x_in
+            # cotangent at the stashed token ids.
+            ex_x = vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype)
+            ex_tok = tokens_mb[0]
+            _, ex_pull = jax.vjp(
+                lambda b, h, x: local_fwd_loss(b, h, x, ex_tok, False),
+                vblocks, head, ex_x,
+            )
+            ex_cot = (
+                vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),
+                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            )
+            _, ex_consts = jax.closure_convert(ex_pull, ex_cot)
+            # ring slots start from the VALID example residuals, not zeros:
+            # drain-tick replays then stay finite before the w=0 mask
+            ring0 = [jnp.repeat(c[None], K + 1, axis=0) for c in ex_consts]
+            tok_ring0 = vzeros(jnp.empty((K + 1, mb, L)), jnp.int32)
+
+            def tick_res(carry, t):
+                fwd_in, cot_in, ring, tok_ring, gblocks, ghead, loss_sum = carry
+
+                # ---- forward slot: run the stage under vjp, stash the
+                # pullback residuals (no recompute at backward) ----------
+                f_idx = t - s
+                fwd_active = jnp.logical_and(f_idx >= 0, f_idx < M)
+                tok_f = tokens_mb[jnp.clip(f_idx, 0, M - 1)]
+                x_first = llama.embed(head, tok_f, cfg)
+                x_in = jnp.where(s == 0, x_first, fwd_in)
+                (x_out, loss_f), pull_f = jax.vjp(
+                    lambda b, h, x: local_fwd_loss(b, h, x, tok_f, False),
+                    vblocks, head, x_in,
+                )
+                # the converted pullback MUST come from this same trace so
+                # the ring's write (consts_f) and read (consts_b) agree on
+                # const ordering; the example trace above only sizes the
+                # ring (its const VALUES are scratch initialization)
+                pull_conv, consts_f = jax.closure_convert(pull_f, ex_cot)
+                idx_w = jnp.where(fwd_active, f_idx % K, K)
+                ring = [
+                    lax.dynamic_update_index_in_dim(r, c, idx_w, 0)
+                    for r, c in zip(ring, consts_f)
+                ]
+                tok_ring = lax.dynamic_update_index_in_dim(
+                    tok_ring, tok_f, idx_w, 0
+                )
+                # loss is banked at the forward slot here (the backward
+                # replay no longer recomputes it)
+                w_f = jnp.where(fwd_active, jnp.float32(1.0), jnp.float32(0.0))
+                loss_sum = loss_sum + w_f * loss_f
+
+                # ---- backward slot: replay the converted pullback on the
+                # ring residuals (same-tick write-then-read serves the
+                # last stage, where f_idx == b_idx) ----------------------
+                b_idx = t - (2 * (S - 1) - s)
+                bwd_active = jnp.logical_and(b_idx >= 0, b_idx < M)
+                idx_r = jnp.clip(jnp.where(bwd_active, b_idx % K, K), 0, K)
+                consts_b = [r[idx_r] for r in ring]
+                tok_b = tok_ring[idx_r]
+                g_out = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
+                g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + 1.0
+                db, dh, dx = pull_conv(
+                    (g_out.astype(x_out.dtype), g_loss), *consts_b
+                )
+                # stage 0's embed grad, by hand: scatter dx at the STASHED
+                # token ids (dh["embed"] from the vjp is zero — the fn no
+                # longer touches it)
+                is0 = jnp.where(s == 0, jnp.float32(1.0), jnp.float32(0.0))
+                dE = jnp.zeros_like(ghead["embed"]).at[
+                    tok_b.reshape(-1)
+                ].add(dx.astype(jnp.float32).reshape(-1, cfg.dmodel))
+                dh = dict(dh, embed=dh["embed"] + is0 * dE)
+                w = jnp.where(bwd_active, jnp.float32(1.0), jnp.float32(0.0))
+                gblocks = jax.tree.map(lambda a, g: a + w * g, gblocks, db)
+                ghead = jax.tree.map(lambda a, g: a + w * g, ghead, dh)
+
+                fwd_next = lax.ppermute(
+                    x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
+                )
+                cot_next = lax.ppermute(
+                    dx, stage_axis, [(i, (i - 1) % S) for i in range(S)]
+                )
+                return (
+                    fwd_next, cot_next, ring, tok_ring, gblocks, ghead,
+                    loss_sum,
+                ), None
+
+            carry0 = (
+                vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),
+                vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),
+                ring0,
+                tok_ring0,
+                *gzero,
+                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            )
+            (_, _, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
+                tick_res, carry0, jnp.arange(T)
+            )
+        else:
+            carry0 = (
+                vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # fwd act
+                vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # cotangent
+                vzeros(jnp.empty((K + 1, mb, L, cfg.dmodel)), dtype),  # stash
+                *gzero,
+                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            )
+            (_, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
+                tick, carry0, jnp.arange(T)
+            )
 
         # mean over microbatches; DP mean over the data axis (the automatic
         # cotangent psum of the GPipe path, done by hand here)
@@ -412,13 +548,16 @@ def make_pipeline_train_step(
     all_reduce + Adam step (``s01_b2_dp_pp.py:93-227``).
 
     ``schedule``: ``"gpipe"`` (scan-transpose backward, parity with the
-    homework B1 microbatch solution) or ``"1f1b"`` (memory-bounded
-    interleaved schedule, parity with ``intro_PP_1F1B.py`` generalized to
-    M microbatches — see :func:`make_1f1b_value_and_grad`).
+    homework B1 microbatch solution), ``"1f1b"`` (memory-bounded
+    interleaved schedule with remat backward, parity with
+    ``intro_PP_1F1B.py`` generalized to M microbatches), or
+    ``"1f1b-stash"`` (non-remat 1F1B: pullback residuals ring-stashed,
+    no forward recompute — see :func:`make_1f1b_value_and_grad`).
     """
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "1f1b-stash"):
         vag = make_1f1b_value_and_grad(
-            cfg, mesh, num_microbatches, stage_axis, data_axis
+            cfg, mesh, num_microbatches, stage_axis, data_axis,
+            stash="residuals" if schedule == "1f1b-stash" else "input",
         )
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(

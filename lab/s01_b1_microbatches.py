@@ -38,10 +38,13 @@ def parse_args(argv=None):
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
                     help="simulate an N-device mesh on CPU")
-    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
-                    help="pipeline schedule: gpipe (homework B1 parity) or "
-                         "1f1b (memory-bounded; activation stash O(S) not "
-                         "O(M))")
+    ap.add_argument("--schedule", choices=("gpipe", "1f1b", "1f1b-stash"),
+                    default="gpipe",
+                    help="pipeline schedule: gpipe (homework B1 parity), "
+                         "1f1b (memory-bounded, remat backward; activation "
+                         "stash O(S) not O(M)), or 1f1b-stash (non-remat "
+                         "1F1B: pullback residuals stashed, no forward "
+                         "recompute)")
     ap.add_argument("--no-flash", action="store_true",
                     help="disable the Pallas flash-attention kernel (on TPU "
                          "it is ON by default; CPU always runs dense)")
