@@ -49,7 +49,8 @@ def parse_args(argv=None):
                     help="llama workload: checkpoint/resume directory; a "
                          "relaunched run continues from the latest step")
     ap.add_argument("--ckpt-every", type=int, default=100)
-    ap.add_argument("--input", choices=("auto", "hbm", "stream", "fixed"),
+    ap.add_argument("--input",
+                    choices=("auto", "hbm-scan", "hbm", "stream", "fixed"),
                     default="auto",
                     help="resnet input pipeline: 'hbm' = whole train split "
                          "resident in device memory with on-device epoch "
@@ -209,7 +210,8 @@ def run_llama(args, jax, jnp):
 
 def run_resnet(args, jax, jnp):
     from ddl25spring_tpu.benchmarks import (
-        InputFeed, build_resnet_step, report_line,
+        DeviceDataset, InputFeed, build_resnet_scan_step, build_resnet_step,
+        report_line,
     )
 
     devices = jax.devices()
@@ -230,56 +232,82 @@ def run_resnet(args, jax, jnp):
     batch = args.batch or (1024 if on_tpu else 4) * n_used
     batch = batch // (dp * M) * (dp * M)
 
-    # the SAME builder + input pipelines bench.py uses (benchmarks.py): raw
-    # uint8 batches in, normalization fused into the jitted step
-    step, params, opt_state, meta = build_resnet_step(
-        devices, dp, S, M, batch, lr=args.lr or 0.1
-    )
     if args.input == "auto":
         # hbm needs batch <= dataset size (50k CIFAR rows); on a slice big
-        # enough to exceed that, auto degrades to the streaming loader
-        mode = "hbm" if batch <= 50_000 else "stream"
+        # enough to exceed that, auto degrades to the streaming loader.
+        # The scan-fused hbm mode is the bench primary (amortized dispatch)
+        # but TPU-only: lax.scan over a conv body is ~55x slower on the
+        # XLA CPU backend (see build_resnet_scan_step)
+        if batch > 50_000:
+            mode = "stream"
+        else:
+            mode = "hbm-scan" if on_tpu else "hbm"
     else:
         mode = args.input
-    if mode == "hbm":
-        from ddl25spring_tpu.benchmarks import DeviceDataset
 
+    # the SAME builders + input pipelines bench.py uses (benchmarks.py):
+    # raw uint8 batches in, normalization fused into the jitted step
+    if mode == "hbm-scan":
         feed = DeviceDataset(batch)
+        K = max(k for k in range(1, 17) if feed.batches_per_epoch % k == 0)
+        multi, step, params, opt_state, meta = build_resnet_scan_step(
+            devices, dp, S, M, batch, K, feed.n, lr=args.lr or 0.1
+        )
     else:
-        feed = InputFeed(batch, stream=(mode == "stream"))
+        K = 1
+        step, params, opt_state, meta = build_resnet_step(
+            devices, dp, S, M, batch, lr=args.lr or 0.1
+        )
+        feed = (
+            DeviceDataset(batch) if mode == "hbm"
+            else InputFeed(batch, stream=(mode == "stream"))
+        )
 
+    input_mode = (
+        f"{feed.input_mode}-scan{K}" if mode == "hbm-scan" else feed.input_mode
+    )
     print(f"resnet18/cifar10: {meta['topology']}, global batch={batch}, "
-          f"{n_used}/{n} device(s) in mesh, input={feed.input_mode}")
+          f"{n_used}/{n} device(s) in mesh, input={input_mode}")
 
     import contextlib
 
     from ddl25spring_tpu.utils.tracing import trace
 
-    # warmup (compile) happens inside timed_run; wrap the timed loop only
+    def one_iter(params, opt_state):
+        if mode == "hbm-scan":
+            return multi(params, opt_state, feed.x, feed.y,
+                         *feed.scan_window(K))
+        return step(params, opt_state, feed.feed())
+
+    n_disp = max(2, iters // K)
+    # warmup (compile) happens before the timer; wrap the timed loop only
     ctx = trace(args.trace_dir) if args.trace_dir else contextlib.nullcontext()
     with ctx:
         for _ in range(3):  # warmup / compile
-            params, opt_state, loss = step(params, opt_state, feed.feed())
+            params, opt_state, loss = one_iter(params, opt_state)
         float(loss)
         t0 = time.perf_counter()
-        for it in range(iters):
-            params, opt_state, loss = step(params, opt_state, feed.feed())
+        for it in range(n_disp):
+            params, opt_state, loss = one_iter(params, opt_state)
             if args.log_every and (it % args.log_every == 0):
-                print(f"iter {it:4d}  loss {float(loss):.4f}", flush=True)
+                # the dispatch returns the loss of its LAST fused step
+                print(f"iter {(it + 1) * K - 1:4d}  loss {float(loss):.4f}",
+                      flush=True)
         float(loss)
         dt = time.perf_counter() - t0
-    sps_chip = iters * batch / dt / n_used
+    sps_chip = n_disp * K * batch / dt / n_used
 
     from ddl25spring_tpu.utils.flops import compiled_flops, mfu
 
-    fl = compiled_flops(step, params, opt_state, feed.fixed)
-    tf, frac = mfu(fl, dt / iters, n_used, devices[0])
+    fixed = getattr(feed, "fixed", None)
+    fl = compiled_flops(step, params, opt_state, fixed)
+    tf, frac = mfu(fl, dt / (n_disp * K), n_used, devices[0])
     if tf is not None:
         print(f"achieved {tf:.2f} TFLOP/s/chip"
               + (f" (MFU {frac:.2%})" if frac is not None else ""))
     if args.trace_dir:
         print(f"profiler trace written to {args.trace_dir}")
-    print(report_line(meta["layout"], sps_chip, feed.input_mode, frac, tf))
+    print(report_line(meta["layout"], sps_chip, input_mode, frac, tf))
     feed.close()
 
 

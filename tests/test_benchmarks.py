@@ -55,3 +55,42 @@ def test_step_counter_survives_many_epochs(ds):
 def test_batch_larger_than_dataset_rejected():
     with pytest.raises(ValueError, match="exceeds dataset size"):
         DeviceDataset(256, n_train=100)
+
+
+def test_scan_step_equals_sequential_steps(devices8):
+    """The K-steps-per-dispatch primary (build_resnet_scan_step) must be
+    the same training as K sequential single-step dispatches on the same
+    DeviceDataset stream (same batches, same updates, up to fp32
+    reassociation across the two compilations) — the scan fuses dispatch
+    overhead away, it must not change semantics."""
+    import jax
+
+    from ddl25spring_tpu.benchmarks import build_resnet_scan_step
+
+    B, K = 16, 2
+    ds = DeviceDataset(B, n_train=64)
+    assert ds.batches_per_epoch % K == 0
+    multi, step1, p0, o0, meta = build_resnet_scan_step(
+        devices8[:1], 1, 1, 1, B, K, ds.n
+    )
+    assert meta["scan_steps"] == K
+
+    ds._i = 0
+    p_ref, o_ref = p0, o0
+    for _ in range(K):
+        p_ref, o_ref, loss_ref = step1(p_ref, o_ref, ds.feed())
+
+    ds._i = 0
+    p_s, o_s, loss_s = multi(p0, o0, ds.x, ds.y, *ds.scan_window(K))
+
+    np.testing.assert_allclose(float(loss_ref), float(loss_s), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4
+        ),
+        jax.device_get(p_ref),
+        jax.device_get(p_s),
+    )
+
+    with pytest.raises(ValueError, match="must divide"):
+        ds.scan_window(3)
