@@ -74,6 +74,65 @@ def test_kill_and_resume_equivalence(tmp_path, train_setup, devices8):
     )
 
 
+def test_zero_sharded_kill_and_resume(tmp_path, train_setup, devices8):
+    """Kill-and-resume with ZeRO/FSDP-SHARDED state: the checkpoint holds
+    [n, k] shard layouts, and the restore template (freshly re-sharded
+    init state) pins each restored leaf back onto its NamedSharding(P
+    ('data')) placement — the production resume path for sharded DP."""
+    from ddl25spring_tpu.parallel.zero import (
+        make_zero_dp_train_step, zero_shard_params,
+    )
+
+    loss_fn, tx, params, batch = train_setup
+    mesh = make_mesh(devices8[:2], data=2)
+    step = make_zero_dp_train_step(
+        loss_fn, tx, mesh, params, per_shard_rng=False
+    )
+    key = jax.random.PRNGKey(4)
+
+    # uninterrupted: 4 steps
+    s_ref = zero_shard_params(params, mesh)
+    o_ref = tx.init(s_ref)
+    for _ in range(4):
+        s_ref, o_ref, _ = step(s_ref, o_ref, batch, key)
+
+    # interrupted: 2 steps, save, crash, restore via fresh template, 2 more
+    ckpt = Checkpointer(tmp_path / "zckpt")
+    s = zero_shard_params(params, mesh)
+    o = tx.init(s)
+    for _ in range(2):
+        s, o, _ = step(s, o, batch, key)
+    ckpt.save(1, {"shards": s, "opt_state": o})
+    ckpt.close()
+
+    from ddl25spring_tpu.utils.checkpoint import with_mesh_placement
+
+    template = {"shards": zero_shard_params(params, mesh)}
+    template["opt_state"] = tx.init(template["shards"])
+    # opt-state scalars (Adam count) are born single-device; the template
+    # must replicate them over the mesh or the resumed jit rejects the
+    # mixed placement — the exact job of with_mesh_placement
+    template = with_mesh_placement(template, mesh)
+    restored, next_step = Checkpointer(tmp_path / "zckpt").restore_or_init(
+        template
+    )
+    assert next_step == 2
+    s2, o2 = restored["shards"], restored["opt_state"]
+    # restored leaves carry the sharded placement, not single-device
+    leaf = jax.tree.leaves(s2)[0]
+    assert leaf.sharding.spec == jax.tree.leaves(template["shards"])[0].sharding.spec
+    for _ in range(2):
+        s2, o2, _ = step(s2, o2, batch, key)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        s2,
+        s_ref,
+    )
+
+
 def test_restore_or_init_fresh_start(tmp_path, train_setup):
     _, tx, params, _ = train_setup
     ckpt = Checkpointer(tmp_path / "empty")
