@@ -122,6 +122,7 @@ def make_ep_moe_fn(
     axis: str = "expert",
     capacity_factor: float = 1.25,
     return_stats: bool = False,
+    data_axis: str | None = None,
 ):
     """EP-sharded MoE: tokens AND experts sharded over ``mesh[axis]``.
 
@@ -131,12 +132,20 @@ def make_ep_moe_fn(
     device holds its local experts' buckets from every shard -> batched
     expert FFN -> ``all_to_all`` back -> local combine.
 
+    ``data_axis``: EP x DP on a 2-D ``(data, expert)`` mesh — tokens
+    shard over BOTH axes, expert stacks shard over ``axis`` and replicate
+    over ``data_axis`` (each data row runs an independent expert-parallel
+    group whose ``all_to_all`` stays inside the row; expert-weight
+    gradients psum over ``data_axis`` automatically, since the stacks are
+    data-invariant inputs under ``shard_map`` autodiff).
+
     ``return_stats=True`` appends ``{"kept": [E], "assigned": T_global}``
     (psum over shards).  Because each shard dispatches its own token group
     with capacity ``T_local*cf/E``, the kept counts equal the dense
     :func:`moe_ffn` run per shard group — pinned in ``tests/test_ep.py``.
     """
     ep = mesh.shape[axis]
+    tok_axes = (data_axis, axis) if data_axis else axis
 
     param_specs = {
         "router": P(),
@@ -148,15 +157,19 @@ def make_ep_moe_fn(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(param_specs, P(axis)),
-        out_specs=(P(axis), P(), P()) if return_stats else (P(axis), P()),
+        in_specs=(param_specs, P(tok_axes)),
+        out_specs=(
+            (P(tok_axes), P(), P())
+            if return_stats else (P(tok_axes), P())
+        ),
     )
     def f(p: Params, x: jax.Array):
         T_local, D = x.shape
         E = p["router"].shape[1]          # global expert count
         E_local = E // ep
         C = max(1, int(T_local * capacity_factor / E))
-        router = lax.pcast(p["router"], axis, to="varying")
+        vary_axes = (axis,) + ((data_axis,) if data_axis else ())
+        router = lax.pcast(p["router"], vary_axes, to="varying")
         logits = x.astype(jnp.float32) @ router
         disp, combine, aux, kept = _dispatch_tensors(logits, C)
 
@@ -182,13 +195,16 @@ def make_ep_moe_fn(
         # shard) — the standard sharded-MoE estimator; it converges to the
         # global loss but is not bitwise equal to it (product of means !=
         # mean of products)
+        # reductions run over the same axes the router was pcast over:
+        # expert, plus data on the 2-D mesh
         if return_stats:
+            n_shards = ep * (mesh.shape[data_axis] if data_axis else 1)
             stats = {
-                "kept": lax.psum(kept, axis),
-                "assigned": jnp.float32(T_local * ep),  # equal-size shards
+                "kept": lax.psum(kept, vary_axes),
+                "assigned": jnp.float32(T_local * n_shards),
             }
-            return y, lax.pmean(aux, axis), stats
-        return y, lax.pmean(aux, axis)
+            return y, lax.pmean(aux, vary_axes), stats
+        return y, lax.pmean(aux, vary_axes)
 
     return f
 

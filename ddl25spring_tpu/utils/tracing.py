@@ -25,7 +25,14 @@ import jax
 
 @contextlib.contextmanager
 def trace(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
-    """Capture a jax.profiler trace of everything inside the block."""
+    """Capture a jax.profiler trace of everything inside the block.
+
+    Caveat for tunneled/proxied TPU transports (e.g. this build image's
+    relay): device-side trace collection can hang the capture
+    indefinitely (observed twice, 25-min budget each — RESULTS §6a).  On
+    such images prefer empirical decomposition (variant timing, batch
+    sweeps); the tracer works normally on directly-attached TPU VMs.
+    """
     options = jax.profiler.ProfileOptions()
     options.host_tracer_level = host_tracer_level
     jax.profiler.start_trace(log_dir, profiler_options=options)
