@@ -11,14 +11,18 @@ Layout (the standard column/row split):
 - column-sharded (output dim): ``wq``, ``wk``, ``wv`` (head dim — heads
   divide over the axis), ``w_gate``, ``w_up``;
 - row-sharded (input dim): ``wo``, ``w_down`` — partial products psum'd;
-- replicated: embed, norms;
-- ``unembed`` is VOCAB-SHARDED by default (``shard_vocab=True``): each
-  device projects to its ``V/n`` logit slice and the causal-LM loss is
-  assembled from per-shard log-sum-exps (one ``all_gather`` of ``[B, L]``
-  scalars + one ``psum``; see :func:`vocab_sharded_lm_loss`) — the full
-  ``[B, L, V]`` logits never materialize on any device, so the TP layout
-  keeps scaling at production vocab sizes (the Megatron
-  parallel-cross-entropy recipe).
+- replicated: norms;
+- ``embed`` and ``unembed`` are VOCAB-SHARDED by default
+  (``shard_vocab=True``): the embedding table holds ``V/n`` rows per
+  device (each shard gathers its own rows, one psum assembles the
+  activations — :func:`vocab_sharded_embed`), the head projects to a
+  ``V/n`` logit slice, and the causal-LM loss is assembled from per-shard
+  log-sum-exps (one ``all_gather`` of ``[B, L]`` scalars + one ``psum``;
+  see :func:`vocab_sharded_lm_loss`) — the full ``[B, L, V]`` logits
+  never materialize on any device and per-device vocab-param memory is
+  ``2·(V/n)·D``, so the TP layout keeps scaling at production vocab
+  sizes (the Megatron parallel-embedding / parallel-cross-entropy
+  recipe).
 
 Composes with DP on a 2-D ``(data, model)`` mesh: the batch shards over
 ``data``, grads psum over ``data`` automatically (invariant params), and each
@@ -58,7 +62,7 @@ def tp_param_specs(
         **{k: P(None, model_axis, None) for k in _ROW},
     }
     return {
-        "embed": P(),
+        "embed": P(model_axis) if shard_vocab else P(),
         "blocks": block,
         "ln_f": P(),
         "unembed": P(None, model_axis) if shard_vocab else P(),
@@ -85,6 +89,32 @@ def shard_tp_params(
     return jax.device_put(params, shardings)
 
 
+def _vocab_shard_ownership(tokens: jax.Array, Vl: int, axis: str):
+    """``(t_local, mine)`` for a vocab id under the contiguous-shard
+    convention (shard i owns ids ``[i*Vl, (i+1)*Vl)``): the clamped local
+    row index and the ownership mask.  Shared by the embed gather and the
+    loss target-pick so the two can never desynchronize."""
+    off = lax.axis_index(axis) * Vl
+    t_local = jnp.clip(tokens - off, 0, Vl - 1)
+    mine = (tokens >= off) & (tokens < off + Vl)
+    return t_local, mine
+
+
+def vocab_sharded_embed(
+    table_local: jax.Array, tokens: jax.Array, axis: str, dtype
+) -> jax.Array:
+    """Embedding gather from a vocab-sharded ``[V/n, D]`` table slice
+    (inside ``shard_map``): each shard gathers its own rows (foreign
+    tokens hit a clamped row and are zeroed by the ownership mask), one
+    ``psum`` assembles the full ``[B, L, D]`` activations — Megatron
+    parallel embedding.  The psum's transpose spreads the activation
+    cotangent back to every shard, whose local scatter-add then touches
+    only its own rows, so the table gradient stays sharded."""
+    t_local, mine = _vocab_shard_ownership(tokens, table_local.shape[0], axis)
+    x = table_local.astype(dtype)[t_local] * mine[..., None].astype(dtype)
+    return lax.psum(x, axis)
+
+
 def vocab_sharded_lm_loss(
     logits: jax.Array, tokens: jax.Array, axis: str
 ) -> jax.Array:
@@ -99,13 +129,11 @@ def vocab_sharded_lm_loss(
     logits = logits[:, :-1].astype(jnp.float32)
     targets = tokens[:, 1:]
     Vl = logits.shape[-1]
-    off = lax.axis_index(axis) * Vl
     lse_loc = jax.scipy.special.logsumexp(logits, axis=-1)   # [B, L-1]
     lse_all = lax.all_gather(lse_loc, axis)                  # [n, B, L-1]
     logz = jax.scipy.special.logsumexp(lse_all, axis=0)
-    t_local = jnp.clip(targets - off, 0, Vl - 1)
+    t_local, mine = _vocab_shard_ownership(targets, Vl, axis)
     picked_l = jnp.take_along_axis(logits, t_local[..., None], -1)[..., 0]
-    mine = (targets >= off) & (targets < off + Vl)
     picked = lax.psum(jnp.where(mine, picked_l, 0.0), axis)
     # all_gather output is VMA-varying though every device holds the same
     # values; the pmean re-types the (already identical) scalar invariant
@@ -129,7 +157,12 @@ def make_tp_loss(
     )
     def tp_loss(params: Params, tokens: jax.Array) -> jax.Array:
         local_blocks = params["blocks"]
-        x = llama.embed(params, tokens, cfg)
+        if shard_vocab:
+            x = vocab_sharded_embed(
+                params["embed"], tokens, model_axis, jnp.dtype(cfg.dtype)
+            )
+        else:
+            x = llama.embed(params, tokens, cfg)
         x = llama.apply_blocks(local_blocks, x, cfg, tp_axis=model_axis)
         # under shard_vocab, params["unembed"] is the local [D, V/n] slice,
         # so llama.unembed emits this device's logit columns unchanged

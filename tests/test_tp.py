@@ -59,6 +59,19 @@ def test_tp_grads_equal_serial(params_and_tokens, devices8):
     )
 
 
+def test_tp_vocab_params_actually_sharded(params_and_tokens, devices8):
+    """The point of shard_vocab: each device holds V/n rows of embed and
+    V/n columns of unembed, not full replicas."""
+    params, _ = params_and_tokens
+    mesh = make_mesh(devices8[:2], model=2)
+    sharded = shard_tp_params(params, mesh)
+    for leaf, dim in ((sharded["embed"], 0), (sharded["unembed"], 1)):
+        s0 = [s for s in leaf.addressable_shards if s.device == devices8[0]]
+        assert s0[0].data.shape[dim] == leaf.shape[dim] // 2, (
+            leaf.shape, s0[0].data.shape, dim,
+        )
+
+
 def test_tp_dp_train_step(params_and_tokens, devices8):
     """2-D (data=2, model=2): one step matches the serial step."""
     params, tokens = params_and_tokens
