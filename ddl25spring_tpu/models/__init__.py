@@ -1,4 +1,5 @@
 from ddl25spring_tpu.models.mnist_cnn import MnistCnn
 from ddl25spring_tpu.models.heart_mlp import HeartDiseaseNN
+from ddl25spring_tpu.models.decode import generate
 
-__all__ = ["MnistCnn", "HeartDiseaseNN"]
+__all__ = ["MnistCnn", "HeartDiseaseNN", "generate"]

@@ -1,0 +1,186 @@
+"""Autoregressive generation with a KV cache for the LLaMA stack.
+
+The reference never samples from its LLaMA (training-loss prints only,
+``lab/s01_b1_microbatches.py:158``); this module completes the model
+family with the standard inference path, TPU-first:
+
+- the KV cache is ONE stacked array pair ``[n_layers, B, max_len, H, hd]``
+  updated in place with ``lax.dynamic_update_slice`` (static shapes — no
+  growing arrays under jit);
+- the decode loop is a ``lax.scan`` over token positions (one compiled
+  step body regardless of length), each step a ``[B, 1]``-token pass over
+  all layers via an inner scan;
+- prefill reuses the same cached step scanned over the prompt (weights
+  are the bandwidth bound at B*1 shapes; a fused prompt pass would only
+  help long prompts);
+- greedy (``temperature=0``) or temperature sampling with explicit PRNG
+  threading.
+
+Equivalence oracle (``tests/test_decode.py``): greedy generation must
+reproduce ``argmax(llama_forward(prompt + generated_so_far)[:, -1])`` at
+every position — the cached incremental pass IS the full forward.  Scope
+of "exact": fp32 dense-attention configs (the attention einsum follows
+the training path's dtype policy, so bf16 rounds each path's
+intermediates in a different order; near-tied logits may then argmax
+differently — inherent to any cached-vs-full comparison in low
+precision).  MoE decode always runs at ample capacity (see
+``_block_decode``), so MoE equivalence holds whenever the full forward
+dropped nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Params = dict[str, Any]
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """``(k, v)`` stacked over layers: ``[L, B, max_len, H, hd]``."""
+    shape = (
+        cfg.n_layers, batch, max_len, cfg.num_heads, cfg.head_dim
+    )
+    dtype = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _block_decode(p: Params, x, k_cache, v_cache, pos, cos, sin,
+                  cfg: LlamaConfig):
+    """One block on a single-token slice ``x [B, 1, D]`` against the
+    layer's cache ``[B, max_len, H, hd]``; returns updated caches."""
+    dtype = jnp.dtype(cfg.dtype)
+    B = x.shape[0]
+    hd = cfg.head_dim
+    max_len = k_cache.shape[1]
+
+    h = llama.rms_norm(x, p["ln1"])
+    q = (h @ p["wq"].astype(dtype)).reshape(B, 1, -1, hd)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, 1, -1, hd)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, 1, -1, hd)
+    q = llama.apply_rope(q, cos, sin)
+    k = llama.apply_rope(k, cos, sin)
+
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    # attention of the one query against positions <= pos; same dtype
+    # policy as the training path (llama.causal_attention): einsum in
+    # cfg.dtype, fp32 softmax — so fp32 configs match the full forward
+    # bitwise
+    s = jnp.einsum("bqhd,bmhd->bhqm", q, k_cache).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(hd))
+    live = jnp.arange(max_len) <= pos
+    s = jnp.where(live[None, None, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhqm,bmhd->bqhd", probs, v_cache)
+    x = x + attn.reshape(B, 1, -1) @ p["wo"].astype(dtype)
+
+    h = llama.rms_norm(x, p["ln2"])
+    if cfg.n_experts > 0:
+        from ddl25spring_tpu.parallel.ep import moe_ffn
+
+        # ample decode-time capacity (C = B): dropping tokens is a
+        # TRAINING regularization artifact; at inference a drop would
+        # silently zero a token's FFN, so decode never drops — and the
+        # teacher-forcing oracle holds whenever the full forward didn't
+        # drop either
+        y, _ = moe_ffn(
+            p["moe"], h.reshape(B, -1),
+            capacity_factor=float(p["moe"]["router"].shape[1]),
+        )
+        x = x + y.reshape(B, 1, -1).astype(dtype)
+    else:
+        gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+        up = h @ p["w_up"].astype(dtype)
+        x = x + (gate * up) @ p["w_down"].astype(dtype)
+    return x, k_cache, v_cache
+
+
+def decode_step(params: Params, cache, tokens_t, pos, cfg: LlamaConfig):
+    """One incremental step: ``tokens_t [B]`` at position ``pos`` ->
+    ``(logits [B, V], cache)``."""
+    k_all, v_all = cache
+    x = llama.embed(params, tokens_t[:, None], cfg)  # [B, 1, D]
+    # rotary phases depend only on the position — computed once per step,
+    # shared by every layer
+    cos, sin = llama.rope_angles(
+        1, cfg.head_dim, pos=pos[None].astype(jnp.float32)
+    )
+
+    def layer(x, inputs):
+        block_p, kc, vc = inputs
+        x, kc, vc = _block_decode(block_p, x, kc, vc, pos, cos, sin, cfg)
+        return x, (kc, vc)
+
+    x, (k_all, v_all) = lax.scan(layer, x, (params["blocks"], k_all, v_all))
+    logits = llama.unembed(params, x, cfg)[:, 0]
+    return logits, (k_all, v_all)
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt [B, P]``.
+
+    Returns ``[B, max_new_tokens]`` int32.  ``temperature=0`` is greedy;
+    otherwise softmax sampling at the given temperature with ``key``.
+    Jittable end to end (prefill scan + decode scan, static shapes).
+    """
+    B, P = prompt.shape
+    L_max = max_len or (P + max_new_tokens)
+    if L_max < P + max_new_tokens:
+        raise ValueError(
+            f"max_len={L_max} < prompt {P} + max_new_tokens "
+            f"{max_new_tokens}: dynamic_update_slice would clamp and "
+            "silently corrupt the cache"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, B, L_max)
+
+    # prefill: feed prompt tokens through the cached step (logits of the
+    # last prompt token seed the first generated one)
+    def pre(carry, inp):
+        cache, _ = carry
+        t, pos = inp
+        logits, cache = decode_step(params, cache, t, pos, cfg)
+        return (cache, logits), None
+
+    (cache, logits), _ = lax.scan(
+        pre,
+        (cache, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
+        (prompt.T, jnp.arange(P)),
+    )
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return logits.argmax(-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / jnp.float32(temperature), axis=-1
+        ).astype(jnp.int32)
+
+    def step(carry, inp):
+        cache, logits, key = carry
+        pos = inp
+        key, sub = jax.random.split(key)
+        tok = pick(logits, sub)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        return (cache, logits, key), tok
+
+    (_, _, _), toks = lax.scan(
+        step, (cache, logits, key), P + jnp.arange(max_new_tokens)
+    )
+    return toks.T  # [B, max_new_tokens]
