@@ -124,6 +124,51 @@ def decode_step(params: Params, cache, tokens_t, pos, cfg: LlamaConfig):
     return logits, (k_all, v_all)
 
 
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Sample token ids from ``logits [B, V]`` — the standard decode
+    controls, all static-shape jittable:
+
+    - ``temperature=0`` -> greedy argmax (``top_k``/``top_p`` ignored);
+    - ``top_k > 0`` -> keep only the k highest logits (``lax.top_k``,
+      static k — no dynamic shapes under jit);
+    - ``top_p < 1`` -> nucleus sampling: keep the smallest prefix of the
+      probability-sorted vocab whose mass reaches ``top_p``.  The
+      highest-probability token is always kept (the prefix is never
+      empty), matching the usual convention.
+
+    Filters compose (k first, then p) by masking pruned entries to -inf;
+    renormalization is implicit in ``jax.random.categorical``.
+    """
+    if temperature == 0.0:
+        return logits.argmax(-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.float32(temperature)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # mass BEFORE each entry; entries whose preceding mass already
+        # reaches top_p are cut, so the first entry always survives
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        cutoff = jnp.sum(
+            jnp.where(cum_before < top_p, 1, 0), axis=-1, keepdims=True
+        )
+        # top_p == 0.0 gives cutoff 0 (cum_before[0] = 0 is not < 0);
+        # clamp so the best token is always kept instead of wrapping
+        # take_along_axis to the weakest logit and disabling the filter
+        cutoff = jnp.maximum(cutoff, 1)
+        threshold = jnp.take_along_axis(sorted_logits, cutoff - 1, axis=-1)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def generate(
     params: Params,
     prompt: jax.Array,
@@ -132,12 +177,16 @@ def generate(
     temperature: float = 0.0,
     key: jax.Array | None = None,
     max_len: int | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
     Returns ``[B, max_new_tokens]`` int32.  ``temperature=0`` is greedy;
-    otherwise softmax sampling at the given temperature with ``key``.
-    Jittable end to end (prefill scan + decode scan, static shapes).
+    otherwise softmax sampling at the given temperature with ``key``,
+    optionally truncated to the ``top_k`` highest logits and/or the
+    ``top_p`` probability nucleus (``sample_logits``).  Jittable end to
+    end (prefill scan + decode scan, static shapes).
     """
     B, P = prompt.shape
     L_max = max_len or (P + max_new_tokens)
@@ -166,11 +215,7 @@ def generate(
     )
 
     def pick(logits, k):
-        if temperature == 0.0:
-            return logits.argmax(-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / jnp.float32(temperature), axis=-1
-        ).astype(jnp.int32)
+        return sample_logits(logits, k, temperature, top_k, top_p)
 
     def step(carry, inp):
         cache, logits, key = carry

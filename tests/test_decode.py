@@ -11,7 +11,12 @@ import numpy as np
 import pytest
 
 from ddl25spring_tpu.models import llama
-from ddl25spring_tpu.models.decode import decode_step, generate, init_kv_cache
+from ddl25spring_tpu.models.decode import (
+    decode_step,
+    generate,
+    init_kv_cache,
+    sample_logits,
+)
 from ddl25spring_tpu.utils.config import LlamaConfig
 
 CFG = LlamaConfig(
@@ -104,3 +109,72 @@ def test_temperature_sampling_deterministic_and_in_range(params_and_prompt):
                  key=jax.random.PRNGKey(8))
     )
     assert not np.array_equal(a, c)  # different key, different sample
+
+
+def test_top_k_restricts_support():
+    """Every top-k sample must land in the k highest logits; k=1 is
+    greedy regardless of key."""
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+    topk_sets = np.asarray(jax.lax.top_k(logits, 5)[1])
+    for seed in range(20):
+        tok = np.asarray(
+            sample_logits(logits, jax.random.PRNGKey(seed),
+                          temperature=1.0, top_k=5)
+        )
+        for b in range(4):
+            assert tok[b] in topk_sets[b]
+    greedy = np.asarray(logits.argmax(-1))
+    for seed in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(sample_logits(logits, jax.random.PRNGKey(seed),
+                                     temperature=1.0, top_k=1)),
+            greedy,
+        )
+
+
+def test_top_p_nucleus_restricts_support():
+    """Nucleus sampling keeps exactly the smallest prefix of the sorted
+    vocab reaching mass p — verified against a numpy reconstruction of
+    the nucleus, plus the always-keep-best edge case at tiny p."""
+    logits = jax.random.normal(jax.random.PRNGKey(1), (3, 16)) * 3.0
+    p = 0.7
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-probs, axis=-1)
+    nucleus = []
+    for b in range(3):
+        mass, keep = 0.0, set()
+        for idx in order[b]:
+            keep.add(int(idx))
+            mass += probs[b, idx]
+            if mass >= p:
+                break
+        nucleus.append(keep)
+    for seed in range(30):
+        tok = np.asarray(
+            sample_logits(logits, jax.random.PRNGKey(seed),
+                          temperature=1.0, top_p=p)
+        )
+        for b in range(3):
+            assert int(tok[b]) in nucleus[b]
+    # p -> 0 degenerates to greedy (the best token is always kept),
+    # including the exact p=0.0 boundary (cutoff clamp)
+    greedy = np.asarray(logits.argmax(-1))
+    for p_edge in (1e-6, 0.0):
+        for seed in range(5):
+            np.testing.assert_array_equal(
+                np.asarray(sample_logits(logits, jax.random.PRNGKey(seed),
+                                         temperature=1.0, top_p=p_edge)),
+                greedy,
+            )
+
+
+def test_generate_with_top_k_p_jits(params_and_prompt):
+    """The filtered samplers thread through the jitted generate loop."""
+    params, prompt = params_and_prompt
+    out = np.asarray(jax.jit(
+        lambda p, t: generate(p, t, CFG, 5, temperature=0.9,
+                              key=jax.random.PRNGKey(3), top_k=8,
+                              top_p=0.9)
+    )(params, prompt))
+    assert out.shape == (2, 5)
+    assert out.min() >= 0 and out.max() < CFG.vocab_size
