@@ -577,6 +577,50 @@ def make_pipeline_train_step(
     return step
 
 
+def fuse_train_steps(step_fn, k: int):
+    """Fuse ``k`` train steps into ONE dispatched program.
+
+    ``step_fn(params, opt_state, tokens) -> (params, opt_state, loss)``
+    (any schedule from :func:`make_pipeline_train_step`) becomes
+    ``multi(params, opt_state, tokens_k)`` over stacked ``[k, B, L]``
+    token batches, scanning the step as the ``lax.scan`` body and
+    returning the per-step ``[k]`` loss vector.
+
+    Why: on a tunneled TPU each Python dispatch pays a ~4 ms host
+    round-trip (measured, RESULTS.md §6a).  At the reference-parity
+    config (batch 3, ctx 256 — 768 tokens/step, `lab/run-b1.sh`) the
+    chip finishes a step in single-digit ms, so dispatch dominates and
+    the fused scan multiplies throughput; at large batch it amortizes to
+    noise.  Same trick as ``benchmarks.build_resnet_scan_step``, input
+    semantics preserved exactly: the K batches are REAL distinct batches
+    staged to HBM once per dispatch (equality with K sequential steps is
+    pinned in ``tests/test_pipeline.py``).  TPU-path oriented: on the
+    XLA CPU backend scans over large bodies run slower than dispatched
+    steps — CPU callers should keep k=1.
+    """
+
+    @jax.jit
+    def multi(params, opt_state, tokens_k):
+        if tokens_k.shape[0] != k:
+            raise ValueError(
+                f"fused for {k} steps but got a window of "
+                f"{tokens_k.shape[0]} batches — caller accounting would "
+                "silently drift"
+            )
+
+        def body(carry, toks):
+            p, o = carry
+            p, o, loss = step_fn(p, o, toks)
+            return (p, o), loss
+
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), tokens_k
+        )
+        return params, opt_state, losses
+
+    return multi
+
+
 def warmup_with_flash_fallback(cfg, build_step, step, *step_args):
     """Run the first (compiling) call of ``step``; if it raises while the
     Pallas flash kernel is enabled, rebuild via ``build_step(dense_cfg)``

@@ -403,3 +403,36 @@ def test_grad_accum_equals_full_batch():
         p_a,
         p_f,
     )
+
+
+def test_fused_steps_equal_sequential(devices8):
+    """fuse_train_steps(step, K) on [K, B, L] stacked batches must land on
+    the same params/losses as K sequential dispatches of the same step
+    (dispatch-amortization must not change semantics)."""
+    from ddl25spring_tpu.parallel.pipeline import fuse_train_steps
+
+    S, M, K = 2, 2, 3
+    mesh = make_mesh(devices8[:S], stage=S)
+    params = llama.init_llama_params(jax.random.PRNGKey(5), CFG)
+    staged = llama.split_blocks_for_stages(params, S)
+    tx = optax.sgd(0.05)
+    step = make_pipeline_train_step(CFG, tx, mesh, M)
+    tokens_k = jax.random.randint(jax.random.PRNGKey(6), (K, 4, 16), 0, 64)
+
+    p_seq, o_seq = staged, tx.init(staged)
+    seq_losses = []
+    for i in range(K):
+        p_seq, o_seq, loss = step(p_seq, o_seq, tokens_k[i])
+        seq_losses.append(float(loss))
+
+    multi = fuse_train_steps(step, K)
+    p_fused, _, losses = multi(staged, tx.init(staged), tokens_k)
+
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-5, rtol=1e-4
+        ),
+        p_fused,
+        p_seq,
+    )
