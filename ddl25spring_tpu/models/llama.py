@@ -190,13 +190,14 @@ def block_forward(
 
     h = rms_norm(x, p["ln2"])
     if cfg.n_experts > 0:
-        if tp_axis is not None:
-            # TP param specs don't cover the moe subtree, and the
-            # row-parallel psum below would scale a replicated MoE output
-            # by the axis size — reject rather than silently mis-train
+        if tp_axis is not None and moe_fn is None:
+            # under TP the default (replicated) moe_ffn would be scaled by
+            # the axis size by the row-parallel psum below — require the
+            # expert-sharded partial-output variant instead
             raise NotImplementedError(
-                "switch-MoE blocks are not supported under tensor "
-                "parallelism; use DP/ZeRO (or EP via moe_fn) instead"
+                "switch-MoE under tensor parallelism needs the expert-"
+                "sharded moe_fn from parallel.tp.make_tp_moe_fn (whose "
+                "partial output the row-parallel psum completes)"
             )
         if moe_fn is None:
             from ddl25spring_tpu.parallel.ep import moe_ffn

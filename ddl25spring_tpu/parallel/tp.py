@@ -28,6 +28,16 @@ Composes with DP on a 2-D ``(data, model)`` mesh: the batch shards over
 ``data``, grads psum over ``data`` automatically (invariant params), and each
 replica group runs identical TP.  ``block_forward(..., tp_axis=...)`` holds
 the actual sharded math; this module shards params and builds the step.
+
+Switch-MoE blocks compose too (``cfg.n_experts > 0``): the expert stacks
+shard over the SAME ``model`` axis (:func:`make_tp_moe_fn`).  Tokens are
+already replicated across that axis under TP, so every shard computes the
+identical global routing/capacity decision, applies only its local expert
+slice, and the block's existing row-parallel ``psum`` assembles the
+output — communication identical to the dense ``w_down`` psum.  Because
+routing stays global (unlike EP's per-shard capacity), TP-MoE is exactly
+the serial :func:`~ddl25spring_tpu.parallel.ep.moe_ffn` result, overflow
+drops included (pinned in ``tests/test_tp.py``).
 """
 
 from __future__ import annotations
@@ -52,15 +62,30 @@ _ROW = ("wo", "w_down")                      # shard input (first of 2) dims
 
 
 def tp_param_specs(
-    model_axis: str = "model", shard_vocab: bool = True
+    model_axis: str = "model",
+    shard_vocab: bool = True,
+    n_experts: int = 0,
 ) -> Params:
     """PartitionSpecs for the llama pytree under TP.  Blocks are stacked
-    ``[L, ...]`` so the weight dims shift right by one."""
+    ``[L, ...]`` so the weight dims shift right by one.
+
+    ``n_experts > 0`` swaps the dense FFN leaves for the ``moe`` subtree:
+    router replicated, expert stacks ``[L, E, ...]`` sharded on the expert
+    dim over the model axis (EP-over-the-TP-axis; see module docstring)."""
     block = {
         "ln1": P(), "ln2": P(),
         **{k: P(None, None, model_axis) for k in _COL},
         **{k: P(None, model_axis, None) for k in _ROW},
     }
+    if n_experts > 0:
+        for k in ("w_gate", "w_up", "w_down"):
+            del block[k]
+        block["moe"] = {
+            "router": P(),
+            "w_gate": P(None, model_axis),
+            "w_up": P(None, model_axis),
+            "w_down": P(None, model_axis),
+        }
     return {
         "embed": P(model_axis) if shard_vocab else P(),
         "blocks": block,
@@ -76,16 +101,15 @@ def shard_tp_params(
     shard_vocab: bool = True,
 ):
     """Place llama params on the mesh with the TP layout."""
-    specs = tp_param_specs(model_axis, shard_vocab)
-    shardings = {
-        "embed": NamedSharding(mesh, specs["embed"]),
-        "blocks": {
-            k: NamedSharding(mesh, specs["blocks"][k])
-            for k in params["blocks"]
-        },
-        "ln_f": NamedSharding(mesh, specs["ln_f"]),
-        "unembed": NamedSharding(mesh, specs["unembed"]),
-    }
+    n_experts = (
+        params["blocks"]["moe"]["router"].shape[-1]
+        if "moe" in params["blocks"] else 0
+    )
+    specs = tp_param_specs(model_axis, shard_vocab, n_experts)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
     return jax.device_put(params, shardings)
 
 
@@ -140,6 +164,40 @@ def vocab_sharded_lm_loss(
     return lax.pmean((logz - picked).mean(), axis)
 
 
+def make_tp_moe_fn(model_axis: str = "model", capacity_factor: float = 1.25):
+    """Switch-MoE FFN for use inside the TP ``shard_map``: expert stacks
+    sharded over the model axis, tokens replicated across it.
+
+    Every shard sees the full token set and the replicated router, so the
+    dispatch/combine tensors — including bucket positions and overflow
+    drops at the GLOBAL capacity ``T*cf/E`` — are computed identically
+    everywhere; each shard then applies only its ``E/n`` expert slice and
+    returns the partial combine, which ``block_forward``'s row-parallel
+    ``psum`` completes.  Exactly the serial ``moe_ffn`` (same routing, same
+    drops), at one ``[T, D]`` psum — no all_to_all needed because TP never
+    sharded the tokens in the first place."""
+    from ddl25spring_tpu.parallel.ep import _dispatch_tensors, _expert_ffn
+
+    def tp_moe(mp: Params, x: jax.Array):
+        T, D = x.shape
+        E = mp["router"].shape[1]           # global expert count
+        E_local = mp["w_gate"].shape[0]     # this shard's slice
+        C = max(1, int(T * capacity_factor / E))
+        logits = x.astype(jnp.float32) @ mp["router"]
+        disp, combine, aux, _ = _dispatch_tensors(logits, C)
+        e0 = lax.axis_index(model_axis) * E_local
+        disp_l = lax.dynamic_slice_in_dim(disp, e0, E_local, axis=1)
+        comb_l = lax.dynamic_slice_in_dim(combine, e0, E_local, axis=1)
+        expert_in = jnp.einsum("tec,td->ecd", disp_l.astype(x.dtype), x)
+        expert_out = _expert_ffn(
+            {k: mp[k] for k in ("w_gate", "w_up", "w_down")}, expert_in
+        )
+        y_partial = jnp.einsum("tec,ecd->td", comb_l.astype(x.dtype), expert_out)
+        return y_partial, aux
+
+    return tp_moe
+
+
 def make_tp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -147,12 +205,21 @@ def make_tp_loss(
     data_axis: str | None = None,
     shard_vocab: bool = True,
 ):
-    """``loss(params, tokens) -> scalar`` with TP(xDP) sharded blocks."""
+    """``loss(params, tokens) -> scalar`` with TP(xDP) sharded blocks.
+    Switch-MoE configs ride the same axis via :func:`make_tp_moe_fn`, with
+    the load-balancing aux loss folded in at ``cfg.moe_aux_weight``."""
+    moe_fn = (
+        make_tp_moe_fn(model_axis, cfg.capacity_factor)
+        if cfg.n_experts > 0 else None
+    )
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(tp_param_specs(model_axis, shard_vocab), P(data_axis)),
+        in_specs=(
+            tp_param_specs(model_axis, shard_vocab, cfg.n_experts),
+            P(data_axis),
+        ),
         out_specs=P(),
     )
     def tp_loss(params: Params, tokens: jax.Array) -> jax.Array:
@@ -163,7 +230,10 @@ def make_tp_loss(
             )
         else:
             x = llama.embed(params, tokens, cfg)
-        x = llama.apply_blocks(local_blocks, x, cfg, tp_axis=model_axis)
+        x, aux = llama.apply_blocks(
+            local_blocks, x, cfg, with_aux=True,
+            tp_axis=model_axis, moe_fn=moe_fn,
+        )
         # under shard_vocab, params["unembed"] is the local [D, V/n] slice,
         # so llama.unembed emits this device's logit columns unchanged
         logits = llama.unembed(params, x, cfg)
@@ -171,6 +241,8 @@ def make_tp_loss(
             loss = vocab_sharded_lm_loss(logits, tokens, model_axis)
         else:
             loss = causal_lm_loss(logits, tokens)
+        if cfg.n_experts > 0:
+            loss = loss + cfg.moe_aux_weight * aux
         if data_axis is not None:
             loss = lax.pmean(loss, data_axis)
         return loss
@@ -186,13 +258,9 @@ def make_tp_train_step(
     data_axis: str | None = None,
     shard_vocab: bool = True,
 ):
-    """Jitted TP(xDP) train step; params stay sharded across steps."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE configs train via llama_forward_with_aux + DP/ZeRO "
-            "(the aux loss would be silently dropped here; TP param specs "
-            "do not cover the moe subtree)"
-        )
+    """Jitted TP(xDP) train step; params stay sharded across steps.
+    Switch-MoE configs shard their expert stacks over the model axis
+    (:func:`make_tp_moe_fn`) and train with the aux loss folded in."""
     loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis, shard_vocab)
 
     @jax.jit

@@ -2,6 +2,8 @@
 unpartitioned model (the SURVEY §4 equivalence oracle, applied to the
 layer-internal sharding axis)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -70,6 +72,101 @@ def test_tp_vocab_params_actually_sharded(params_and_tokens, devices8):
         assert s0[0].data.shape[dim] == leaf.shape[dim] // 2, (
             leaf.shape, s0[0].data.shape, dim,
         )
+
+
+MOE_CFG = LlamaConfig(
+    vocab_size=64, dmodel=32, num_heads=4, n_layers=2, ctx_size=16,
+    dtype="float32", n_experts=4, capacity_factor=1.0,
+)
+
+
+def serial_moe_loss(params, tokens):
+    logits, aux = llama.llama_forward_with_aux(params, tokens, MOE_CFG)
+    return causal_lm_loss(logits, tokens) + MOE_CFG.moe_aux_weight * aux
+
+
+@pytest.fixture(scope="module")
+def moe_params_and_tokens():
+    params = llama.init_llama_params(jax.random.PRNGKey(2), MOE_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0, 64)
+    return params, tokens
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_moe_loss_equals_serial(moe_params_and_tokens, tp, devices8):
+    """Expert-sharded TP MoE ≡ serial moe_ffn EXACTLY — global routing and
+    the tight capacity_factor=1.0 overflow drops are computed identically
+    on every shard (unlike EP's per-shard capacity)."""
+    params, tokens = moe_params_and_tokens
+    mesh = make_mesh(devices8[:tp], model=tp)
+    loss = make_tp_loss(MOE_CFG, mesh)
+    l_tp = float(jax.jit(loss)(shard_tp_params(params, mesh), tokens))
+    np.testing.assert_allclose(
+        l_tp, float(serial_moe_loss(params, tokens)), rtol=1e-5
+    )
+
+
+def test_tp_moe_grads_equal_serial(moe_params_and_tokens, devices8):
+    params, tokens = moe_params_and_tokens
+    mesh = make_mesh(devices8[:2], model=2)
+    loss = make_tp_loss(MOE_CFG, mesh)
+    g_tp = jax.jit(jax.grad(loss))(shard_tp_params(params, mesh), tokens)
+    g_serial = jax.grad(serial_moe_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5
+        ),
+        g_tp,
+        g_serial,
+    )
+
+
+def test_tp_moe_expert_stacks_actually_sharded(moe_params_and_tokens, devices8):
+    params, _ = moe_params_and_tokens
+    mesh = make_mesh(devices8[:2], model=2)
+    sharded = shard_tp_params(params, mesh)
+    moe = sharded["blocks"]["moe"]
+    for k in ("w_gate", "w_up", "w_down"):
+        s0 = [s for s in moe[k].addressable_shards if s.device == devices8[0]]
+        assert s0[0].data.shape[1] == MOE_CFG.n_experts // 2, (
+            k, s0[0].data.shape,
+        )
+    # router replicated: every shard holds the full [L, D, E]
+    r0 = moe["router"].addressable_shards[0]
+    assert r0.data.shape == moe["router"].shape
+
+
+def test_tp_dp_moe_train_step(moe_params_and_tokens, devices8):
+    """2-D (data=2, model=2) with MoE blocks: one step matches the serial
+    per-data-shard oracle.  Each data row routes its own half-batch (its
+    own aux estimate — the standard sharded-MoE mean-of-shard-losses), so
+    the oracle is the mean of serial losses over the two halves."""
+    params, tokens = moe_params_and_tokens
+    cfg = dataclasses.replace(MOE_CFG, capacity_factor=4.0)
+    mesh = make_mesh(devices8[:4], data=2, model=2)
+    tx = optax.adam(1e-3)
+    step = make_tp_train_step(cfg, tx, mesh, data_axis="data")
+    sharded = shard_tp_params(params, mesh)
+    new_params, _, loss = step(sharded, tx.init(sharded), tokens)
+
+    def serial(params, tokens):
+        def one(tk):
+            logits, aux = llama.llama_forward_with_aux(params, tk, cfg)
+            return causal_lm_loss(logits, tk) + cfg.moe_aux_weight * aux
+
+        return 0.5 * (one(tokens[:2]) + one(tokens[2:]))
+
+    sstep_loss, g = jax.value_and_grad(serial)(params, tokens)
+    updates, _ = tx.update(g, tx.init(params), params)
+    expect = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(float(loss), float(sstep_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        ),
+        new_params,
+        expect,
+    )
 
 
 def test_tp_dp_train_step(params_and_tokens, devices8):
