@@ -39,6 +39,11 @@ def _dense(key, shape, scale=0.02):
     return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
 
 
+# the non-FFN block params (the schema init_block_params lays down);
+# sharding-spec builders key off this so they cannot drift from the model
+ATTN_BLOCK_KEYS = ("ln1", "wq", "wk", "wv", "wo", "ln2")
+
+
 def init_block_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     d, f = cfg.dmodel, cfg.ffn_dim
     ks = jax.random.split(key, 7)

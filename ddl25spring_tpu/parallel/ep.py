@@ -117,6 +117,62 @@ def moe_ffn(
     return y, aux
 
 
+def ep_moe_local(
+    p: Params,
+    x: jax.Array,
+    *,
+    axis: str,
+    ep: int,
+    capacity_factor: float = 1.25,
+    vary_axes: tuple[str, ...] = (),
+    return_stats: bool = False,
+):
+    """The expert-parallel MoE body, for use INSIDE an enclosing
+    ``shard_map``: ``x [T_local, D]`` is this shard's token slice along
+    ``axis`` (size ``ep``), ``p`` holds the local ``[E/ep, ...]`` expert
+    stacks and the replicated router.  Returns the per-shard ``(y, aux)``
+    (aux NOT reduced over shards — callers choose the estimator; with
+    stats, the local kept/assigned counts).
+
+    ``vary_axes``: mesh axes the router param is *invariant* over but the
+    tokens vary over (it is pcast before use).  Factored out of
+    :func:`make_ep_moe_fn` so other sharded programs — e.g. the pipeline,
+    whose blocks already run inside a ``(data, stage)`` shard_map — can
+    ride expert parallelism over one of their existing axes
+    (``parallel.pipeline`` EP x DP x PP)."""
+    T_local, D = x.shape
+    E = p["router"].shape[1]          # global expert count
+    E_local = E // ep
+    C = max(1, int(T_local * capacity_factor / E))
+    router = p["router"]
+    if vary_axes:
+        router = lax.pcast(router, vary_axes, to="varying")
+    logits = x.astype(jnp.float32) @ router
+    disp, combine, aux, kept = _dispatch_tensors(logits, C)
+
+    expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
+    # regroup [E, C, D] = [ep, E_local, C, D]: hand shard s's buckets
+    # for expert group g to device g; receive every shard's buckets for
+    # OUR experts (dim0 becomes the source shard)
+    a2a = lax.all_to_all(
+        expert_in.reshape(ep, E_local, C, D), axis, 0, 0, tiled=False
+    )                                  # [ep, E_local, C, D], dim0 = src
+    mine = a2a.transpose(1, 0, 2, 3).reshape(E_local, ep * C, D)
+    # the sharded-in expert stacks are already this device's [E_local,...]
+    out = _expert_ffn(
+        {k: p[k] for k in ("w_gate", "w_up", "w_down")}, mine
+    )
+    back = lax.all_to_all(
+        out.reshape(E_local, ep, C, D).transpose(1, 0, 2, 3), axis, 0, 0,
+        tiled=False,
+    )                                  # [ep, E_local, C, D] -> our tokens
+    expert_out = back.reshape(E, C, D)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    if return_stats:
+        return y, aux, kept
+    return y, aux
+
+
 def make_ep_moe_fn(
     mesh: Mesh,
     axis: str = "expert",
@@ -164,33 +220,11 @@ def make_ep_moe_fn(
         ),
     )
     def f(p: Params, x: jax.Array):
-        T_local, D = x.shape
-        E = p["router"].shape[1]          # global expert count
-        E_local = E // ep
-        C = max(1, int(T_local * capacity_factor / E))
         vary_axes = (axis,) + ((data_axis,) if data_axis else ())
-        router = lax.pcast(p["router"], vary_axes, to="varying")
-        logits = x.astype(jnp.float32) @ router
-        disp, combine, aux, kept = _dispatch_tensors(logits, C)
-
-        expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
-        # regroup [E, C, D] = [ep, E_local, C, D]: hand shard s's buckets
-        # for expert group g to device g; receive every shard's buckets for
-        # OUR experts (dim0 becomes the source shard)
-        a2a = lax.all_to_all(
-            expert_in.reshape(ep, E_local, C, D), axis, 0, 0, tiled=False
-        )                                  # [ep, E_local, C, D], dim0 = src
-        mine = a2a.transpose(1, 0, 2, 3).reshape(E_local, ep * C, D)
-        # the sharded-in expert stacks are already this device's [E_local,...]
-        out = _expert_ffn(
-            {k: p[k] for k in ("w_gate", "w_up", "w_down")}, mine
+        res = ep_moe_local(
+            p, x, axis=axis, ep=ep, capacity_factor=capacity_factor,
+            vary_axes=vary_axes, return_stats=return_stats,
         )
-        back = lax.all_to_all(
-            out.reshape(E_local, ep, C, D).transpose(1, 0, 2, 3), axis, 0, 0,
-            tiled=False,
-        )                                  # [ep, E_local, C, D] -> our tokens
-        expert_out = back.reshape(E, C, D)
-        y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
         # aux is the mean of per-shard switch losses (each over its token
         # shard) — the standard sharded-MoE estimator; it converges to the
         # global loss but is not bitwise equal to it (product of means !=
@@ -198,12 +232,14 @@ def make_ep_moe_fn(
         # reductions run over the same axes the router was pcast over:
         # expert, plus data on the 2-D mesh
         if return_stats:
+            y, aux, kept = res
             n_shards = ep * (mesh.shape[data_axis] if data_axis else 1)
             stats = {
                 "kept": lax.psum(kept, vary_axes),
-                "assigned": jnp.float32(T_local * n_shards),
+                "assigned": jnp.float32(x.shape[0] * n_shards),
             }
             return y, lax.pmean(aux, vary_axes), stats
+        y, aux = res
         return y, lax.pmean(aux, vary_axes)
 
     return f

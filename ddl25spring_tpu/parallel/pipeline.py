@@ -48,10 +48,26 @@ Params = dict[str, Any]
 # PartitionSpec prefix for staged llama params: blocks carry a leading
 # [num_stages] dim sharded over the stage axis; embed/unembed replicated
 # (cheap relative to blocks; the FLOPs live in the MXU matmuls).
-def staged_param_specs(stage_axis: str = "stage") -> Params:
+def staged_param_specs(
+    stage_axis: str = "stage", ep_axis: str | None = None
+) -> Params:
+    """``ep_axis``: additionally shard the switch-MoE expert stacks over
+    that axis (dim 2 of the ``[S, L/S, E, ...]`` stacks) — expert
+    parallelism riding the pipeline's data axis, so each device holds
+    ``E/n`` experts per stage instead of all ``E`` (see
+    :func:`make_pipeline_loss`)."""
+    blocks: Any = P(stage_axis)
+    if ep_axis is not None:
+        blocks = {k: P(stage_axis) for k in llama.ATTN_BLOCK_KEYS}
+        blocks["moe"] = {
+            "router": P(stage_axis),
+            "w_gate": P(stage_axis, None, ep_axis),
+            "w_up": P(stage_axis, None, ep_axis),
+            "w_down": P(stage_axis, None, ep_axis),
+        }
     return {
         "embed": P(),
-        "blocks": P(stage_axis),
+        "blocks": blocks,
         "ln_f": P(),
         "unembed": P(),
     }
@@ -64,6 +80,7 @@ def make_pipeline_loss(
     stage_axis: str = "stage",
     data_axis: str | None = None,
     remat: bool = False,
+    ep_axis: str | None = None,
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
 
@@ -89,17 +106,53 @@ def make_pipeline_loss(
     ``causal_lm_loss + w * aux`` from
     :func:`~ddl25spring_tpu.models.llama.llama_forward_with_aux` — asserted
     in ``tests/test_pipeline.py``.
+
+    ``ep_axis`` (must be the data axis): EP x DP x PP — the expert stacks
+    shard over the data axis too, so each device holds ``E/n`` experts per
+    stage, with :func:`~ddl25spring_tpu.parallel.ep.ep_moe_local` moving
+    capacity buckets between data rows via ``all_to_all`` each tick.
+    Routing/capacity stay per-data-shard (decided before the a2a), so the
+    loss is EXACTLY the dense replicated-expert pipeline's — drops
+    included — while per-device expert memory falls from ``E`` to
+    ``E/n`` stacks (pinned in ``tests/test_pipeline.py``).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
+
+    moe_fn = None
+    if ep_axis is not None:
+        if cfg.n_experts <= 0:
+            raise ValueError("ep_axis given but cfg.n_experts == 0")
+        if ep_axis != data_axis:
+            # tokens shard over data only; an EP axis the tokens are
+            # replicated over would all_to_all duplicate work
+            raise ValueError(
+                f"ep_axis {ep_axis!r} must be the data axis {data_axis!r}"
+            )
+        ep_n = mesh.shape[ep_axis]
+        if cfg.n_experts % ep_n:
+            raise ValueError(
+                f"{cfg.n_experts} experts not divisible by "
+                f"{ep_axis}={ep_n}"
+            )
+        from ddl25spring_tpu.parallel.ep import ep_moe_local
+
+        def moe_fn(mp, flat):
+            # router is stage-varying but data-invariant inside this
+            # shard_map; ep_moe_local pcasts it over the EP(=data) axis
+            return ep_moe_local(
+                mp, flat, axis=ep_axis, ep=ep_n,
+                capacity_factor=cfg.capacity_factor,
+                vary_axes=(ep_axis,),
+            )
 
     tok_spec = P(None, data_axis)  # [M, mb, L]: shard microbatch dim over data
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(staged_param_specs(stage_axis), tok_spec),
+        in_specs=(staged_param_specs(stage_axis, ep_axis), tok_spec),
         out_specs=P(),
     )
     def pipelined(params: Params, tokens_mb: jax.Array) -> jax.Array:
@@ -127,7 +180,7 @@ def make_pipeline_loss(
             x_in = jnp.where(s == 0, x_first, incoming)
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
-                    local_blocks, x_in, cfg, with_aux=True
+                    local_blocks, x_in, cfg, with_aux=True, moe_fn=moe_fn
                 )
                 # stage s works on microbatch t-s; aux from drain-tick
                 # garbage is masked (the weight also zeroes its cotangent)
@@ -542,6 +595,7 @@ def make_pipeline_train_step(
     stage_axis: str = "stage",
     data_axis: str | None = None,
     schedule: str = "gpipe",
+    ep_axis: str | None = None,
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -553,15 +607,28 @@ def make_pipeline_train_step(
     ``intro_PP_1F1B.py`` generalized to M microbatches), or
     ``"1f1b-stash"`` (non-remat 1F1B: pullback residuals ring-stashed,
     no forward recompute — see :func:`make_1f1b_value_and_grad`).
+
+    ``ep_axis``: shard the MoE expert stacks over the data axis too
+    (EP x DP x PP, gpipe schedule only — see :func:`make_pipeline_loss`);
+    pass params through ``shard_staged_params(..., ep_axis=...)``.
     """
     if schedule in ("1f1b", "1f1b-stash"):
+        if ep_axis is not None:
+            raise NotImplementedError(
+                "EP expert sharding rides the gpipe schedule; the 1F1B "
+                "ticks run the stage body inside lax.cond (skip-dead-"
+                "compute), where the EP all_to_all would be a collective "
+                "in non-uniform control flow — keep experts replicated "
+                "under 1F1B"
+            )
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="residuals" if schedule == "1f1b-stash" else "input",
         )
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
-            cfg, mesh, num_microbatches, stage_axis, data_axis
+            cfg, mesh, num_microbatches, stage_axis, data_axis,
+            ep_axis=ep_axis,
         )
         vag = jax.value_and_grad(loss_fn)
     else:
@@ -648,16 +715,31 @@ def warmup_with_flash_fallback(cfg, build_step, step, *step_args):
         return step(*step_args), step, cfg
 
 
-def shard_staged_params(params: Params, mesh: Mesh, stage_axis: str = "stage"):
+def shard_staged_params(
+    params: Params,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    ep_axis: str | None = None,
+):
     """Place staged params on the mesh: blocks sharded over the stage axis,
     the rest replicated — each device holds only its stages' layers, like
-    each reference rank building only its own ``LLamaStage``."""
-    specs = staged_param_specs(stage_axis)
+    each reference rank building only its own ``LLamaStage``.  With
+    ``ep_axis``, the expert stacks additionally shard over that axis
+    (each device then holds only ``E/n`` experts of its stages)."""
+    specs = staged_param_specs(stage_axis, ep_axis)
+    blocks_spec = specs["blocks"]
+    if isinstance(blocks_spec, P):
+        blocks = jax.tree.map(
+            lambda _: NamedSharding(mesh, blocks_spec), params["blocks"]
+        )
+    else:
+        blocks = jax.tree.map(
+            lambda sp: NamedSharding(mesh, sp), blocks_spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
     shardings = {
         "embed": NamedSharding(mesh, specs["embed"]),
-        "blocks": jax.tree.map(
-            lambda _: NamedSharding(mesh, specs["blocks"]), params["blocks"]
-        ),
+        "blocks": blocks,
         "ln_f": NamedSharding(mesh, specs["ln_f"]),
         "unembed": NamedSharding(mesh, specs["unembed"]),
     }
