@@ -1,13 +1,25 @@
-"""Sequence/context parallelism: ring attention over a ``seq`` mesh axis.
+"""Sequence/context parallelism over a ``seq`` mesh axis: ring + Ulysses.
 
 The reference caps context at 256 tokens with unsharded attention (SURVEY §5:
 long-context absent) — this module is the TPU-native long-context extension.
 Tokens shard over a ``seq`` axis: each device holds ``L/n`` positions of
-every sequence, activations never materialize full length, and attention runs
-as a RING — each of ``n`` steps combines the local queries with one rotating
-KV block (online-softmax accumulation in fp32), then ``ppermute``s the KV
-block to the next neighbor over ICI.  Compute overlaps transfer by structure:
-the permute is inside the same scanned step XLA schedules around the matmuls.
+every sequence and activations never materialize full length outside
+attention.  Two strategies cover the two classic designs:
+
+- **ring** (default): attention runs as a RING — each of ``n`` steps
+  combines the local queries with one rotating KV block (online-softmax
+  accumulation in fp32), then ``ppermute``s the KV block to the next
+  neighbor over ICI.  Compute overlaps transfer by structure: the permute is
+  inside the same scanned step XLA schedules around the matmuls.  Scales to
+  any ``n``; O(L/n · d) resident per shard with the flash local step.
+- **ulysses** (DeepSpeed-Ulysses style): one ``all_to_all`` re-shards
+  q/k/v from sequence-sharded ``[B, L/n, H, hd]`` to head-sharded
+  ``[B, L, H/n, hd]``, each device runs FULL-length causal attention over
+  its head subset (the Pallas flash kernel at full L on TPU), and a second
+  ``all_to_all`` restores sequence sharding.  Two collectives total per
+  attention (vs ``n`` ring hops) at the price of ``H % n == 0`` and
+  full-``L`` attention residency per device — the right trade when heads
+  are plentiful and the per-device flash pass fits.
 
 Causality is handled by GLOBAL positions: query at global position i attends
 key at global position j iff j <= i, so rotated blocks are masked per
@@ -178,11 +190,47 @@ def ring_flash_attention(
     return o_acc.astype(dtype)
 
 
+def ulysses_attention(q, k, v, axis: str, dtype):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention
+    inside ``shard_map``.
+
+    ``q/k/v``: ``[B, Ll, H, hd]`` sequence shards (RoPE already applied at
+    GLOBAL positions by the caller, so the re-gathered sequence carries the
+    right phases).  One tiled ``all_to_all`` turns the ``seq`` sharding into
+    a head sharding ``[B, n*Ll, H/n, hd]`` — shard ``s`` holds contiguous
+    positions ``[s*Ll, (s+1)*Ll)`` (the :func:`make_sp_loss` layout), so the
+    index-ordered concat reassembles the true sequence — then full-length
+    causal attention runs locally (Pallas flash on TPU, dense off-TPU where
+    the interpreter cannot run under VMA-checked shard_map), and the inverse
+    ``all_to_all`` restores ``[B, Ll, H, hd]``.
+    """
+    n = lax.psum(1, axis)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"ulysses needs heads divisible by the seq axis: H={H}, n={n}"
+        )
+    # one ingress collective: q/k/v stacked -> a single tiled all_to_all
+    qkv = jnp.stack((q, k, v))  # [3, B, Ll, H, hd]
+    qkv = lax.all_to_all(qkv, axis, split_axis=3, concat_axis=2, tiled=True)
+    qg, kg, vg = qkv[0], qkv[1], qkv[2]
+    if jax.default_backend() == "tpu":
+        from ddl25spring_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(qg, kg, vg)
+    else:
+        o = llama.causal_attention(qg, kg, vg, dtype)
+    return lax.all_to_all(
+        o.astype(dtype), axis, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
 def make_sp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
     seq_axis: str = "seq",
     data_axis: str | None = None,
+    mode: str = "ring",
 ):
     """``loss(params, tokens) -> scalar``: full llama forward with tokens
     sharded ``[B, L/n]`` over ``seq_axis`` and ring attention in every block.
@@ -193,8 +241,19 @@ def make_sp_loss(
     LOCAL ``[B*L/n, D]`` token group and the weighted aux loss is the
     ``pmean`` of per-shard switch losses — the standard sharded-MoE
     estimator (same note as :mod:`ddl25spring_tpu.parallel.ep`), so it is
-    not bitwise the unsharded aux under overflow."""
+    not bitwise the unsharded aux under overflow.
+
+    ``mode`` selects the attention strategy: ``"ring"`` (rotating KV blocks;
+    flash local step when ``cfg.use_flash``) or ``"ulysses"`` (two
+    all_to_alls re-shard seq -> heads; needs ``num_heads % n == 0``)."""
     n = mesh.shape[seq_axis]
+    if mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown SP mode {mode!r}")
+    if mode == "ulysses" and cfg.num_heads % n:
+        raise ValueError(
+            f"ulysses SP needs num_heads ({cfg.num_heads}) divisible by "
+            f"the {seq_axis!r} axis size ({n})"
+        )
 
     @partial(
         shard_map,
@@ -209,7 +268,10 @@ def make_sp_loss(
         offset = lax.axis_index(seq_axis) * Ll
         pos = offset + jnp.arange(Ll)
 
-        if cfg.use_flash:
+        if mode == "ulysses":
+            def attn(q, k, v, dtype):
+                return ulysses_attention(q, k, v, seq_axis, dtype)
+        elif cfg.use_flash:
             # flash local step + lse merge: O(Ll·d) per-shard attention
             def attn(q, k, v, dtype):
                 return ring_flash_attention(q, k, v, seq_axis, dtype)
@@ -263,9 +325,10 @@ def make_sp_train_step(
     mesh: Mesh,
     seq_axis: str = "seq",
     data_axis: str | None = None,
+    mode: str = "ring",
 ):
     """Jitted SP(xDP) train step (params replicated, tokens seq-sharded)."""
-    loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis)
+    loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis, mode)
 
     @jax.jit
     def step(params, opt_state, tokens):

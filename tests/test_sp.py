@@ -126,6 +126,56 @@ def test_sp_moe_aux_reaches_loss(devices8):
     np.testing.assert_allclose(l_sp2, l_serial, rtol=0.05)
 
 
+def test_ulysses_loss_and_grads_equal_serial(params_and_tokens, devices8):
+    """All-to-all (Ulysses) SP ≡ serial — values and grads.  Off-TPU the
+    local full-length step is dense causal attention; the two tiled
+    all_to_alls (seq -> heads -> seq) are what this pins."""
+    params, tokens = params_and_tokens
+    mesh = make_mesh(devices8[:2], seq=2)  # num_heads=2 -> 1 head/device
+    loss = make_sp_loss(CFG, mesh, mode="ulysses")
+    np.testing.assert_allclose(
+        float(jax.jit(loss)(params, tokens)),
+        float(serial_loss(params, tokens)),
+        rtol=1e-5,
+    )
+    g_sp = jax.jit(jax.grad(loss))(params, tokens)
+    g_serial = jax.grad(serial_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g_sp,
+        g_serial,
+    )
+
+
+def test_ulysses_rejects_indivisible_heads(devices8):
+    mesh = make_mesh(devices8[:4], seq=4)  # 2 heads over 4 shards: no
+    with pytest.raises(ValueError, match="divisible"):
+        make_sp_loss(CFG, mesh, mode="ulysses")
+
+
+def test_ulysses_dp_train_step(params_and_tokens, devices8):
+    """(data=2, seq=2) Ulysses: one step matches the serial step."""
+    params, tokens = params_and_tokens
+    mesh = make_mesh(devices8[:4], data=2, seq=2)
+    tx = optax.adam(1e-3)
+    step = make_sp_train_step(CFG, tx, mesh, data_axis="data", mode="ulysses")
+    new_params, _, loss = step(params, tx.init(params), tokens)
+
+    sloss, g = jax.value_and_grad(serial_loss)(params, tokens)
+    updates, _ = tx.update(g, tx.init(params), params)
+    expect = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        new_params,
+        expect,
+    )
+
+
 def test_sp_dp_train_step(params_and_tokens, devices8):
     """(data=2, seq=4): one step matches the serial step on the same batch."""
     params, tokens = params_and_tokens
