@@ -28,27 +28,35 @@ class NativeLoaderUnavailable(RuntimeError):
     """Toolchain or data missing — use the numpy path instead."""
 
 
+def load_native_lib(lib_name: str) -> ctypes.CDLL:
+    """Build-on-demand + load for a ``native/`` shared library: shared by
+    the C++ dataloader and BPE bindings so the make/CDLL/error handling
+    lives once.  Raises :class:`NativeLoaderUnavailable` when the
+    toolchain or artifact is unusable (callers fall back to Python)."""
+    so = _NATIVE_DIR / lib_name
+    if not so.exists():
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR), lib_name],
+                check=True, capture_output=True, text=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise NativeLoaderUnavailable(
+                f"building {lib_name} failed: {detail}"
+            ) from e
+    try:
+        return ctypes.CDLL(str(so))
+    except OSError as e:  # wrong arch / corrupt .so: fall back, don't crash
+        raise NativeLoaderUnavailable(f"loading {so} failed: {e}") from e
+
+
 def _load_lib():
     global _lib
     with _lock:
         if _lib is not None:
             return _lib
-        so = _NATIVE_DIR / _LIB_NAME
-        if not so.exists():
-            try:
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True, capture_output=True, text=True,
-                )
-            except (OSError, subprocess.CalledProcessError) as e:
-                detail = getattr(e, "stderr", "") or str(e)
-                raise NativeLoaderUnavailable(
-                    f"building {_LIB_NAME} failed: {detail}"
-                ) from e
-        try:
-            lib = ctypes.CDLL(str(so))
-        except OSError as e:  # wrong arch / corrupt .so: fall back, don't crash
-            raise NativeLoaderUnavailable(f"loading {so} failed: {e}") from e
+        lib = load_native_lib(_LIB_NAME)
         lib.dl_create.restype = ctypes.c_void_p
         lib.dl_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,

@@ -20,12 +20,52 @@ save -> load -> encode -> LLaMA train step) in ``tests/test_text_data.py``.
 
 from __future__ import annotations
 
+import ctypes
 import json
 import os
 import re
+import threading
 from pathlib import Path
 
 import numpy as np
+
+# ---------------------------------------------------------------- native BPE
+# The reference's tokenizer is native C++ (SentencePiece inside simplellm);
+# the in-tree equivalent keeps the hot encode loop native too: native/bpe.cc
+# implements the exact greedy merge scan, built on demand like the C++
+# dataloader.  Failure to build/load falls back to the Python loop silently
+# (same contract, just slower).
+_BPE_LIB_NAME = "libddl25_bpe.so"
+_bpe_lib_lock = threading.Lock()
+_bpe_lib: ctypes.CDLL | bool | None = None  # None=untried, False=unavailable
+
+
+def _load_bpe_lib():
+    global _bpe_lib
+    with _bpe_lib_lock:
+        if _bpe_lib is not None:
+            return _bpe_lib or None
+        from ddl25spring_tpu.data.native_loader import (
+            NativeLoaderUnavailable, load_native_lib,
+        )
+
+        try:
+            lib = load_native_lib(_BPE_LIB_NAME)
+        except NativeLoaderUnavailable:
+            _bpe_lib = False
+            return None
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_create.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.bpe_destroy.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode.restype = ctypes.c_long
+        lib.bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _bpe_lib = lib
+        return lib
 
 
 class ByteTokenizer:
@@ -90,7 +130,7 @@ class BpeTokenizer:
     eos_id = 2
     _BYTE0 = 3  # id of byte 0
 
-    def __init__(self, merges: list[tuple[int, int]]):
+    def __init__(self, merges: list[tuple[int, int]], native: bool = True):
         self.merges = [tuple(m) for m in merges]
         self._rank = {m: i for i, m in enumerate(self.merges)}
         self.vocab_size = 256 + self._BYTE0 + len(self.merges)
@@ -100,6 +140,27 @@ class BpeTokenizer:
         }
         for i, (a, b) in enumerate(self.merges):
             self._bytes[259 + i] = self._bytes[a] + self._bytes[b]
+        # native C++ encode loop (native/bpe.cc) when buildable; the
+        # Python path below is the reference implementation and fallback
+        self._native = None
+        lib = _load_bpe_lib() if native else None
+        if lib is not None:
+            flat = np.asarray(self.merges, np.int32).reshape(-1)
+            handle = lib.bpe_create(
+                flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                len(self.merges),
+            )
+            if handle:
+                self._native = (lib, ctypes.c_void_p(handle))
+
+    def __del__(self):
+        native = getattr(self, "_native", None)
+        if native is not None:
+            lib, handle = native
+            try:
+                lib.bpe_destroy(handle)
+            except Exception:  # interpreter teardown: lib may be gone
+                pass
 
     # ------------------------------------------------------------ training
     @classmethod
@@ -169,6 +230,15 @@ class BpeTokenizer:
         return ids
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        if self._native is not None:
+            lib, handle = self._native
+            data = text.encode("utf-8")
+            out = np.empty(len(data) + 1, np.int32)  # ids never outnumber bytes
+            n = lib.bpe_encode(
+                handle, data, len(data), int(add_bos),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            return out[:n].tolist()
         ids = [self.bos_id] if add_bos else []
         for chunk in re.findall(r"\s*\S+|\s+$", text):
             ids.extend(self._encode_chunk(chunk.encode("utf-8")))

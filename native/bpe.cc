@@ -1,0 +1,167 @@
+// Native byte-level BPE encoder.
+//
+// Role: the framework's C++ runtime component for tokenization.  The
+// reference's tokenizer IS native C++ — simplellm's SPTokenizer wraps
+// SentencePiece (`lab/s01_b1_microbatches.py:6,31`; SURVEY §2 "native
+// components") — so the in-tree equivalent keeps the hot encode loop
+// native too: the greedy lowest-rank merge scan runs here, called through
+// ctypes from ddl25spring_tpu/data/tokenizer.py (which transparently
+// falls back to its pure-Python loop when the toolchain is absent).
+//
+// Semantics are BYTE-IDENTICAL to BpeTokenizer.encode:
+//   - text is chunked by the Python regex `\s*\S+|\s+$` under Python-str
+//     whitespace classification (the Unicode \s set below, enumerated from
+//     CPython's re module), whitespace traveling with the following word;
+//   - per chunk, ids start as byte+3 and the lowest-(rank, position)
+//     adjacent pair is merged until no learnable pair remains — the exact
+//     loop of BpeTokenizer._encode_chunk, including leftmost tie-break;
+//   - id space: 0/1/2 pad/bos/eos, 3..258 bytes, 259+i = merge i.
+//
+// C ABI (ctypes-consumed):
+//   bpe_create(const int32_t* merges /* [n*2] */, int n) -> handle
+//   bpe_encode(h, const uint8_t* utf8, long len, int add_bos,
+//              int32_t* out /* cap >= len+1 */) -> id count
+//   bpe_destroy(h)
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+constexpr int kByte0 = 3;
+constexpr int kBosId = 1;
+constexpr int kFirstMergeId = 259;
+
+// Python re `\s` for str (CPython 3.12): enumerated via
+//   [i for i in range(0x110000) if re.match(r'\s', chr(i))]
+bool IsPySpace(uint32_t cp) {
+  switch (cp) {
+    case 0x09: case 0x0a: case 0x0b: case 0x0c: case 0x0d:
+    case 0x1c: case 0x1d: case 0x1e: case 0x1f: case 0x20:
+    case 0x85: case 0xa0: case 0x1680:
+    case 0x2000: case 0x2001: case 0x2002: case 0x2003: case 0x2004:
+    case 0x2005: case 0x2006: case 0x2007: case 0x2008: case 0x2009:
+    case 0x200a: case 0x2028: case 0x2029: case 0x202f: case 0x205f:
+    case 0x3000:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// Decode one UTF-8 codepoint at data[i]; advances i past it.  Invalid
+// sequences consume one byte and yield a non-space sentinel — chunking
+// then treats the raw byte as word content, matching how Python would
+// have already replaced it before regex chunking (encode() receives str,
+// so input bytes here are valid UTF-8 from Python; this is just safety).
+uint32_t NextCodepoint(const uint8_t* data, long len, long& i) {
+  uint8_t b = data[i];
+  int extra = 0;
+  uint32_t cp = b;
+  if (b >= 0xf0) { extra = 3; cp = b & 0x07; }
+  else if (b >= 0xe0) { extra = 2; cp = b & 0x0f; }
+  else if (b >= 0xc0) { extra = 1; cp = b & 0x1f; }
+  else if (b >= 0x80) { i += 1; return 0xFFFD; }  // bare continuation byte
+  if (i + extra >= len) { i += 1; return 0xFFFD; }
+  for (int k = 1; k <= extra; ++k) cp = (cp << 6) | (data[i + k] & 0x3f);
+  i += 1 + extra;
+  return cp;
+}
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return (static_cast<size_t>(p.first) << 32) ^
+           static_cast<uint32_t>(p.second);
+  }
+};
+
+struct Bpe {
+  std::unordered_map<std::pair<int32_t, int32_t>, int32_t, PairHash> rank;
+  int n_merges = 0;
+};
+
+// The exact loop of BpeTokenizer._encode_chunk: repeatedly merge the
+// lowest-(rank, position) adjacent pair.  Chunks are words, so the
+// quadratic rescan is over short sequences; ids shrink in place.
+void EncodeChunk(const Bpe& bpe, const uint8_t* data, long begin, long end,
+                 std::vector<int32_t>& ids, std::vector<int32_t>& out) {
+  ids.clear();
+  for (long i = begin; i < end; ++i) ids.push_back(kByte0 + data[i]);
+  while (ids.size() > 1) {
+    int32_t best_rank = bpe.n_merges;
+    size_t best_j = 0;
+    for (size_t j = 0; j + 1 < ids.size(); ++j) {
+      auto it = bpe.rank.find({ids[j], ids[j + 1]});
+      if (it != bpe.rank.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_j = j;
+      }
+    }
+    if (best_rank == bpe.n_merges) break;
+    ids[best_j] = kFirstMergeId + best_rank;
+    ids.erase(ids.begin() + best_j + 1);
+  }
+  out.insert(out.end(), ids.begin(), ids.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_create(const int32_t* merges, int n) {
+  Bpe* b = new Bpe();
+  b->n_merges = n;
+  b->rank.reserve(n * 2);
+  for (int i = 0; i < n; ++i) {
+    // assignment, not emplace: duplicate pairs keep the LAST rank, matching
+    // the Python dict-comprehension in BpeTokenizer.__init__
+    b->rank[std::make_pair(merges[2 * i], merges[2 * i + 1])] = i;
+  }
+  return b;
+}
+
+void bpe_destroy(void* h) { delete static_cast<Bpe*>(h); }
+
+long bpe_encode(void* h, const uint8_t* utf8, long len, int add_bos,
+                int32_t* out_buf) {
+  const Bpe& bpe = *static_cast<Bpe*>(h);
+  std::vector<int32_t> out;
+  out.reserve(len + 1);
+  if (add_bos) out.push_back(kBosId);
+
+  // chunk by `\s*\S+|\s+$`: scan codepoints, emitting [ws-run][word] chunks;
+  // a trailing pure-ws run is its own final chunk
+  std::vector<int32_t> scratch;
+  long i = 0;
+  while (i < len) {
+    long chunk_begin = i;
+    // optional leading whitespace
+    long j = i;
+    while (j < len) {
+      long k = j;
+      if (!IsPySpace(NextCodepoint(utf8, len, k))) break;
+      j = k;
+    }
+    if (j == len) {
+      // trailing whitespace only: the `\s+$` alternative
+      EncodeChunk(bpe, utf8, chunk_begin, len, scratch, out);
+      break;
+    }
+    // the word: non-space codepoints
+    while (j < len) {
+      long k = j;
+      if (IsPySpace(NextCodepoint(utf8, len, k))) break;
+      j = k;
+    }
+    EncodeChunk(bpe, utf8, chunk_begin, j, scratch, out);
+    i = j;
+  }
+  for (size_t k = 0; k < out.size(); ++k) out_buf[k] = out[k];
+  return static_cast<long>(out.size());
+}
+
+}  // extern "C"

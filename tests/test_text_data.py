@@ -67,6 +67,32 @@ def test_bpe_trains_compresses_roundtrips(tmp_path):
     assert tok2.vocab_size == tok.vocab_size
 
 
+def test_native_bpe_encode_matches_python():
+    """The C++ encode loop (native/bpe.cc, the in-tree analogue of the
+    reference's native SentencePiece tokenizer) must be byte-identical to
+    the Python reference implementation — chunking (Python-str \\s
+    semantics incl. Unicode whitespace), leftmost-lowest-rank merges, bos
+    handling.  Skipped only where the toolchain can't build the lib."""
+    tok = BpeTokenizer.train(_train_corpus(), n_merges=128)
+    if tok._native is None:
+        pytest.skip("native BPE lib unavailable (no toolchain)")
+    py = BpeTokenizer(tok.merges, native=False)
+    assert py._native is None
+    cases = [
+        "", "   ", "a", " a", "a ", "trailing ws   ", "\n\nleading",
+        "One day Tom went to the park. The cat found a red ball.",
+        "Tabs\tand  spaces Ünïcòde \n newlines",
+        "nbsp\xa0thin ideo　sep done",  # unicode \s chunking
+        "café naïve 你好世界",
+    ]
+    for text in cases:
+        for bos in (True, False):
+            assert tok.encode(text, add_bos=bos) == py.encode(
+                text, add_bos=bos
+            ), repr(text)
+        assert tok.decode(tok.encode(text)) == text
+
+
 def test_get_tokenizer_discovers_bpe_artifact(tmp_path, monkeypatch):
     """get_tokenizer() artifact discovery mirrors the reference's fetched
     SPTokenizer model file (s01_b1_microbatches.py:31)."""
