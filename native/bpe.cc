@@ -74,8 +74,10 @@ uint32_t NextCodepoint(const uint8_t* data, long len, long& i) {
 
 struct PairHash {
   size_t operator()(const std::pair<int32_t, int32_t>& p) const {
-    return (static_cast<size_t>(p.first) << 32) ^
-           static_cast<uint32_t>(p.second);
+    // widen to uint64_t before the 32-bit shift (UB on 32-bit size_t)
+    return static_cast<size_t>(
+        (static_cast<uint64_t>(static_cast<uint32_t>(p.first)) << 32) ^
+        static_cast<uint32_t>(p.second));
   }
 };
 
