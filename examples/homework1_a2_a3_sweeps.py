@@ -88,16 +88,30 @@ def main(argv=None):
                     help="run a subset of the grid (resume partial sweeps)")
     ap.add_argument("--server", choices=("both", "fedsgd", "fedavg"),
                     default="both", help="A2: restrict to one server family")
+    ap.add_argument("--data", choices=("mnist", "digits"), default="mnist",
+                    help="'digits' = the REAL UCI handwritten digits "
+                         "bundled with sklearn (upsampled to 28x28): "
+                         "real-data sweeps on the zero-egress image, where "
+                         "'mnist' falls back to the synthetic set that "
+                         "saturates every config")
     args = ap.parse_args(argv)
 
     from ddl25spring_tpu.utils.platform import force_cpu_devices
 
     force_cpu_devices(args.force_cpu_devices)
 
-    if args.n_train:
+    global DATA
+    if args.data == "digits":
+        from ddl25spring_tpu.data.mnist import load_digits_28x28
+
+        DATA = load_digits_28x28(
+            n_train=args.n_train or 1437, n_test=args.n_test or 360
+        )
+        print("# REAL data: UCI handwritten digits (sklearn bundled), "
+              f"n_train={len(DATA['y_train'])}, n_test={len(DATA['y_test'])}")
+    elif args.n_train:
         from ddl25spring_tpu.data.mnist import load_mnist
 
-        global DATA
         DATA = load_mnist(
             n_train=args.n_train, n_test=args.n_test or 2000
         )

@@ -2,9 +2,34 @@
 (reference splitter semantics at ``lab/tutorial_1a/hfl_complete.py:91-104``)."""
 
 import numpy as np
+import pytest
 
-from ddl25spring_tpu.data.mnist import load_mnist
+from ddl25spring_tpu.data.mnist import load_digits_28x28, load_mnist
 from ddl25spring_tpu.data.splitter import split_indices, stack_client_data
+
+
+def test_digits_real_data_mnist_shaped():
+    pytest.importorskip("sklearn")  # optional dep: ships the real digits
+    """The sklearn-bundled UCI digits (REAL handwritten data on the
+    zero-egress image) must drop into every MNIST consumer: same shapes,
+    dtypes, normalization constants; train/test disjoint and
+    deterministic."""
+    load_digits_28x28.cache_clear()
+    d = load_digits_28x28()
+    assert d["x_train"].shape == (1437, 28, 28, 1)
+    assert d["x_test"].shape == (360, 28, 28, 1)
+    assert d["y_train"].dtype == np.int32
+    assert set(np.unique(d["y_train"])) == set(range(10))
+    # normalized like load_mnist: background pixels sit at (0-MEAN)/STD
+    from ddl25spring_tpu.data.mnist import MEAN, STD
+
+    assert np.isclose(d["x_train"].min(), (0.0 - MEAN) / STD, atol=1e-6)
+    load_digits_28x28.cache_clear()
+    d2 = load_digits_28x28()
+    np.testing.assert_array_equal(d["x_train"], d2["x_train"])
+    # real data: images within a class differ (no synthetic prototype)
+    zeros = d["x_train"][d["y_train"] == 0]
+    assert not np.allclose(zeros[0], zeros[1])
 
 
 def test_mnist_deterministic_and_normalized():
