@@ -314,6 +314,37 @@ def split_blocks_for_stages(params: Params, num_stages: int) -> Params:
     return out
 
 
+def split_blocks_interleaved(
+    params: Params, num_stages: int, num_chunks: int
+) -> Params:
+    """Reshape stacked blocks ``[L, ...] -> [S, V, L/(S·V), ...]`` for the
+    interleaved virtual-stage pipeline: device ``s`` holds the ``V`` chunks
+    ``{v·S + s}`` (Megatron-LM interleaving), so ``blocks[s][v]`` is global
+    chunk ``v·S + s`` = layers ``[(v·S+s)·Lc, (v·S+s+1)·Lc)``."""
+    L = jax.tree.leaves(params["blocks"])[0].shape[0]
+    S, V = num_stages, num_chunks
+    if L % (S * V):
+        raise ValueError(f"{L} layers not divisible by S*V = {S}*{V}")
+    per = L // (S * V)
+    out = dict(params)
+    out["blocks"] = jax.tree.map(
+        # [L] -> [V, S, Lc] (chunk-major: g = v*S + s) -> [S, V, Lc]
+        lambda x: x.reshape((V, S, per) + x.shape[1:]).swapaxes(0, 1),
+        params["blocks"],
+    )
+    return out
+
+
+def merge_blocks_interleaved(params: Params) -> Params:
+    """Inverse of :func:`split_blocks_interleaved`."""
+    out = dict(params)
+    out["blocks"] = jax.tree.map(
+        lambda x: x.swapaxes(0, 1).reshape((-1,) + x.shape[3:]),
+        params["blocks"],
+    )
+    return out
+
+
 def merge_blocks_from_stages(params: Params) -> Params:
     """Inverse of :func:`split_blocks_for_stages`."""
     out = dict(params)

@@ -81,6 +81,7 @@ def make_pipeline_loss(
     data_axis: str | None = None,
     remat: bool = False,
     ep_axis: str | None = None,
+    num_chunks: int = 1,
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
 
@@ -115,10 +116,27 @@ def make_pipeline_loss(
     loss is EXACTLY the dense replicated-expert pipeline's — drops
     included — while per-device expert memory falls from ``E`` to
     ``E/n`` stacks (pinned in ``tests/test_pipeline.py``).
+
+    ``num_chunks > 1`` selects the INTERLEAVED virtual-stage schedule —
+    see :func:`make_interleaved_pipeline_loss` for the schedule design;
+    this function is the single implementation of both (``V == 1``
+    reduces the slot map to plain GPipe).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
+    V = num_chunks
     dtype = jnp.dtype(cfg.dtype)
+    if V > 1:
+        if ep_axis is not None:
+            raise NotImplementedError(
+                "EP expert sharding rides the plain (num_chunks=1) "
+                "gpipe schedule only"
+            )
+        if M % S:
+            raise ValueError(
+                f"interleaved schedule needs microbatches ({M}) divisible "
+                f"by stages ({S})"
+            )
 
     moe_fn = None
     if ep_axis is not None:
@@ -174,41 +192,62 @@ def make_pipeline_loss(
 
         def tick(carry, t):
             incoming, loss_sum = carry
-            # stage 0 injects microbatch t (embed is a cheap gather; the
-            # clamp keeps the index static-shaped during drain ticks)
-            x_first = llama.embed(head, tokens_mb[jnp.minimum(t, M - 1)], cfg)
-            x_in = jnp.where(s == 0, x_first, incoming)
+            # forward slot k = t - s; the slot -> (chunk v, microbatch m)
+            # map is Megatron's interleaved grouping (see
+            # make_interleaved_pipeline_loss), reducing to plain GPipe
+            # (v = 0, m = k) at V == 1
+            k = t - s
+            active = jnp.logical_and(k >= 0, k < M * V)
+            if V == 1:
+                m = jnp.clip(k, 0, M - 1)
+                chunk = local_blocks
+                inject = s == 0
+                finish = s == S - 1
+            else:
+                g, j = jnp.divmod(jnp.clip(k, 0, M * V - 1), V * S)
+                v, r = jnp.divmod(j, S)
+                m = g * S + r
+                chunk = jax.tree.map(
+                    lambda x: lax.dynamic_index_in_dim(
+                        x, v, 0, keepdims=False
+                    ),
+                    local_blocks,
+                )
+                inject = jnp.logical_and(s == 0, v == 0)
+                finish = jnp.logical_and(s == S - 1, v == V - 1)
+
+            # the first (virtual) stage injects microbatch m (embed is a
+            # cheap gather; the clamp keeps the index static during drain)
+            x_first = llama.embed(head, tokens_mb[m], cfg)
+            x_in = jnp.where(inject, x_first, incoming)
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
-                    local_blocks, x_in, cfg, with_aux=True, moe_fn=moe_fn
+                    chunk, x_in, cfg, with_aux=True, moe_fn=moe_fn
                 )
-                # stage s works on microbatch t-s; aux from drain-tick
-                # garbage is masked (the weight also zeroes its cotangent)
-                f_idx = t - s
-                w_f = jnp.where(
-                    jnp.logical_and(f_idx >= 0, f_idx < M), 1.0, 0.0
-                ).astype(jnp.float32)
+                # aux from drain-tick garbage is masked (the weight also
+                # zeroes its cotangent)
+                w_f = jnp.where(active, 1.0, 0.0).astype(jnp.float32)
                 aux_term = w_f * jnp.float32(cfg.moe_aux_weight) * aux
             else:
-                x_out = llama.apply_blocks(local_blocks, x_in, cfg)
+                x_out = llama.apply_blocks(chunk, x_in, cfg)
                 aux_term = jnp.float32(0.0)
 
-            # last stage finishes microbatch t-(S-1) on this tick
-            done = t - (S - 1)
-            tgt = tokens_mb[jnp.clip(done, 0, M - 1)]
+            # the last (virtual) stage finishes microbatch m on this tick.
             # lax.cond so non-last stages skip the unembed matmul entirely;
             # the zero branch must carry the same varying-axis type as the
             # loss branch (JAX 0.9 shard_map VMA typing)
             loss_mb = lax.cond(
-                jnp.logical_and(s == S - 1, done >= 0),
+                jnp.logical_and(finish, active),
                 lambda x, y: causal_lm_loss(llama.unembed(head, x, cfg), y),
                 lambda x, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
-                tgt,
+                tokens_mb[m],
             )
 
             # hand activation to the next stage: the isend/irecv chain of
-            # s01_b1_microbatches.py:87-140 as one collective-permute
+            # s01_b1_microbatches.py:87-140 as one collective-permute (at
+            # V > 1 the wrap S-1 -> 0 is the chunk v -> v+1 hand-off,
+            # arriving exactly one tick before its consumption slot)
             outgoing = lax.ppermute(
                 x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
             )
@@ -219,7 +258,9 @@ def make_pipeline_loss(
             lax.pcast(jnp.float32(0.0), axes, to="varying"),
         )
         tick_fn = jax.checkpoint(tick) if remat else tick
-        (_, loss_sum), _ = lax.scan(tick_fn, carry0, jnp.arange(M + S - 1))
+        (_, loss_sum), _ = lax.scan(
+            tick_fn, carry0, jnp.arange(M * V + S - 1)
+        )
 
         total = lax.psum(loss_sum, stage_axis) / M
         if data_axis is not None:
@@ -234,6 +275,60 @@ def make_pipeline_loss(
         return pipelined(params, tokens_mb)
 
     return loss
+
+
+def make_interleaved_pipeline_loss(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    num_chunks: int,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+    remat: bool = False,
+):
+    """Interleaved virtual-stage pipeline (Megatron-LM-style chunking).
+
+    Each device holds ``V = num_chunks`` NON-contiguous layer chunks
+    (device ``s`` owns global chunks ``{v·S + s}``, split by
+    :func:`~ddl25spring_tpu.models.llama.split_blocks_interleaved`), and
+    the schedule streams each microbatch around the device ring ``V``
+    times.  Why: the pipeline bubble is per-*chunk*, not per-stage —
+    schedule length is ``M·V + S - 1`` chunk-ticks versus the
+    non-interleaved ``V·(M + S - 1)`` chunk-times of work+bubble, saving
+    ``(V-1)(S-1)`` chunk-times of bubble (the classic interleaved
+    schedule; bubble fraction falls ~V×) at the price of ``V×`` the
+    boundary traffic — the right trade on TPU, where the hop is one ICI
+    collective-permute.
+
+    Tick algebra (the whole schedule is these four lines): at tick ``t``
+    device ``s`` runs forward slot ``k = t - s``; slot ``k`` maps to
+    ``(chunk v, microbatch m)`` by Megatron's grouping —
+
+    - ``g, j = divmod(k, V·S)`` (group of S microbatches, position in it)
+    - ``v, r = divmod(j, S)``; ``m = g·S + r``
+
+    so each device does chunk 0 for S microbatches, then chunk 1 for the
+    same S, ..., then the next group.  One ``ppermute`` ring hop per tick
+    serves every transfer: producer ``(v, m, s)`` finishes at tick
+    ``k + s`` and consumer ``(v, m, s+1)`` reads at ``k + s + 1``; the
+    wrap ``S-1 → 0`` lands exactly where device 0 needs the ``v+1``
+    input ``S`` slots later (``m`` re-enters chunk ``v+1`` after the
+    group's other S-1 microbatches).  Device 0 injects the embed on its
+    ``v == 0`` slots; device S-1 takes unembed+loss on its ``v == V-1``
+    slots.  Backward is the scan transpose (GPipe-style; ``remat=True``
+    checkpoints each tick), which replays the same reduced-bubble
+    schedule in reverse.
+
+    Constraints: ``M % S == 0`` (groups of S microbatches — the standard
+    interleaved-schedule requirement) and ``n_layers % (S·V) == 0``.
+    ``num_chunks=1`` reduces exactly to :func:`make_pipeline_loss`, which
+    holds the single implementation of both schedules — this wrapper is
+    the named entry point for the interleaved design documented above.
+    """
+    return make_pipeline_loss(
+        cfg, mesh, num_microbatches, stage_axis, data_axis, remat,
+        num_chunks=num_chunks,
+    )
 
 
 def make_1f1b_value_and_grad(
@@ -596,6 +691,7 @@ def make_pipeline_train_step(
     data_axis: str | None = None,
     schedule: str = "gpipe",
     ep_axis: str | None = None,
+    num_chunks: int = 1,
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -604,15 +700,28 @@ def make_pipeline_train_step(
     ``schedule``: ``"gpipe"`` (scan-transpose backward, parity with the
     homework B1 microbatch solution), ``"1f1b"`` (memory-bounded
     interleaved schedule with remat backward, parity with
-    ``intro_PP_1F1B.py`` generalized to M microbatches), or
+    ``intro_PP_1F1B.py`` generalized to M microbatches),
     ``"1f1b-stash"`` (non-remat 1F1B: pullback residuals ring-stashed,
-    no forward recompute — see :func:`make_1f1b_value_and_grad`).
+    no forward recompute — see :func:`make_1f1b_value_and_grad`), or
+    ``"interleaved"`` (virtual-stage chunking with ``num_chunks`` chunks
+    per device, bubble reduced ~V× — see
+    :func:`make_interleaved_pipeline_loss`; params split by
+    ``split_blocks_interleaved``).
 
     ``ep_axis``: shard the MoE expert stacks over the data axis too
     (EP x DP x PP, gpipe schedule only — see :func:`make_pipeline_loss`);
     pass params through ``shard_staged_params(..., ep_axis=...)``.
     """
-    if schedule in ("1f1b", "1f1b-stash"):
+    if schedule == "interleaved":
+        if ep_axis is not None:
+            raise NotImplementedError(
+                "EP expert sharding rides the gpipe schedule only"
+            )
+        loss_fn = make_interleaved_pipeline_loss(
+            cfg, mesh, num_microbatches, num_chunks, stage_axis, data_axis,
+        )
+        vag = jax.value_and_grad(loss_fn)
+    elif schedule in ("1f1b", "1f1b-stash"):
         if ep_axis is not None:
             raise NotImplementedError(
                 "EP expert sharding rides the gpipe schedule; the 1F1B "

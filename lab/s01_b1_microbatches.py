@@ -38,13 +38,19 @@ def parse_args(argv=None):
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
                     help="simulate an N-device mesh on CPU")
-    ap.add_argument("--schedule", choices=("gpipe", "1f1b", "1f1b-stash"),
+    ap.add_argument("--schedule",
+                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved"),
                     default="gpipe",
                     help="pipeline schedule: gpipe (homework B1 parity), "
                          "1f1b (memory-bounded, remat backward; activation "
-                         "stash O(S) not O(M)), or 1f1b-stash (non-remat "
+                         "stash O(S) not O(M)), 1f1b-stash (non-remat "
                          "1F1B: pullback residuals stashed, no forward "
-                         "recompute)")
+                         "recompute), or interleaved (virtual-stage "
+                         "chunking, --chunks per device; bubble ~/V)")
+    ap.add_argument("--chunks", type=int, default=2, metavar="V",
+                    help="interleaved schedule: layer chunks per device "
+                         "(needs microbatches %% stages == 0 and "
+                         "n_layers %% (stages*V) == 0)")
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="fuse K train steps per dispatched program "
                          "(lax.scan over K stacked batches); 0 = auto "
@@ -103,12 +109,21 @@ def main(argv=None) -> None:
           f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
-    staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
+    if args.schedule == "interleaved":
+        split = lambda p: llama.split_blocks_interleaved(p, S, args.chunks)
+    else:
+        split = lambda p: llama.split_blocks_for_stages(p, S)
+    staged = shard_staged_params(split(params), mesh)
     tx = optax.adam(args.lr)
     opt_state = tx.init(staged)
-    step = make_pipeline_train_step(
-        cfg, tx, mesh, args.microbatches, schedule=args.schedule
-    )
+
+    def build_step(c):
+        return make_pipeline_train_step(
+            c, tx, mesh, args.microbatches, schedule=args.schedule,
+            num_chunks=args.chunks,
+        )
+
+    step = build_step(cfg)
 
     ds = iter(TinyStories(tokenizer, batch_size=args.batch, seq_l=args.seq_len))
     # warmup outside the timer: jit compile dominates the first step
@@ -116,11 +131,7 @@ def main(argv=None) -> None:
 
     tokens = jnp.asarray(next(ds))
     (staged, opt_state, loss), step, cfg = warmup_with_flash_fallback(
-        cfg,
-        lambda c: make_pipeline_train_step(
-            c, tx, mesh, args.microbatches, schedule=args.schedule
-        ),
-        step, staged, opt_state, tokens,
+        cfg, build_step, step, staged, opt_state, tokens,
     )
     float(loss)
 

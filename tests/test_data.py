@@ -9,11 +9,11 @@ from ddl25spring_tpu.data.splitter import split_indices, stack_client_data
 
 
 def test_digits_real_data_mnist_shaped():
-    pytest.importorskip("sklearn")  # optional dep: ships the real digits
     """The sklearn-bundled UCI digits (REAL handwritten data on the
     zero-egress image) must drop into every MNIST consumer: same shapes,
     dtypes, normalization constants; train/test disjoint and
     deterministic."""
+    pytest.importorskip("sklearn")  # optional dep: ships the real digits
     load_digits_28x28.cache_clear()
     d = load_digits_28x28()
     assert d["x_train"].shape == (1437, 28, 28, 1)

@@ -17,6 +17,7 @@ from ddl25spring_tpu.ops.losses import causal_lm_loss
 from ddl25spring_tpu.parallel.pipeline import (
     make_1f1b_value_and_grad,
     make_grad_accum_step,
+    make_interleaved_pipeline_loss,
     make_pipeline_loss,
     make_pipeline_train_step,
     shard_staged_params,
@@ -504,4 +505,114 @@ def test_fused_steps_equal_sequential(devices8):
         ),
         p_fused,
         p_seq,
+    )
+
+
+# ---------------------------------------------------------------- interleaved
+
+
+def test_interleaved_split_merge_roundtrip():
+    params = llama.init_llama_params(jax.random.PRNGKey(2), CFG)
+    split = llama.split_blocks_interleaved(params, 2, 2)
+    leaf = jax.tree.leaves(split["blocks"])[0]
+    assert leaf.shape[:3] == (2, 2, 1)  # [S, V, Lc]
+    back = llama.merge_blocks_interleaved(split)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params, back
+    )
+    # chunk mapping: blocks[s][v] is global chunk v*S + s
+    l0 = params["blocks"]["wq"]
+    np.testing.assert_array_equal(split["blocks"]["wq"][1, 0, 0], l0[1])
+    np.testing.assert_array_equal(split["blocks"]["wq"][0, 1, 0], l0[2])
+
+
+@pytest.mark.parametrize("mbs", [2, 4])
+def test_interleaved_loss_and_grads_equal_serial(
+    params_and_tokens, mbs, devices8
+):
+    """The virtual-stage schedule (V=2 chunks/device) must match the
+    serial model exactly — the tick algebra (slot -> (chunk, microbatch)
+    map, single-ring delay-1 transfers, wrap-to-chunk-v+1) is all pinned
+    by this equality."""
+    params, tokens = params_and_tokens
+    tokens = tokens[:4]  # B=4: divisible by both M values
+    S, V = 2, 2
+    mesh = make_mesh(devices8[:S], stage=S)
+    staged = llama.split_blocks_interleaved(params, S, V)
+    loss = make_interleaved_pipeline_loss(CFG, mesh, mbs, V)
+    np.testing.assert_allclose(
+        float(jax.jit(loss)(staged, tokens)),
+        float(serial_loss(params, tokens)),
+        rtol=1e-5,
+    )
+    g = jax.jit(jax.grad(loss))(staged, tokens)
+    g_serial = jax.grad(serial_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_interleaved(g),
+    )
+
+
+def test_interleaved_rejects_indivisible_microbatches(devices8):
+    mesh = make_mesh(devices8[:2], stage=2)
+    with pytest.raises(ValueError, match="divisible"):
+        make_interleaved_pipeline_loss(CFG, mesh, 3, 2)
+
+
+def test_interleaved_dp_pp_train_step(params_and_tokens, devices8):
+    """schedule='interleaved' on the 2-D (data, stage) mesh: one step
+    equals the serial step."""
+    params, tokens = params_and_tokens
+    tokens = tokens[:4]
+    S, V, M = 2, 2, 2
+    mesh = make_mesh(devices8[:4], data=2, stage=S)
+    staged = shard_staged_params(
+        llama.split_blocks_interleaved(params, S, V), mesh
+    )
+    tx = optax.adam(1e-3)
+    step = make_pipeline_train_step(
+        CFG, tx, mesh, M, data_axis="data", schedule="interleaved",
+        num_chunks=V,
+    )
+    new_params, _, loss = step(staged, tx.init(staged), tokens)
+
+    sloss, g = jax.value_and_grad(serial_loss)(params, tokens)
+    updates, _ = tx.update(g, tx.init(params), params)
+    expect = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(float(loss), float(sloss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=1e-5, rtol=1e-4
+        ),
+        llama.merge_blocks_interleaved(jax.device_get(new_params)),
+        expect,
+    )
+
+
+def test_interleaved_moe_equals_serial(devices8):
+    """Switch-MoE rides the interleaved schedule: per-(chunk, microbatch)
+    dispatch groups are the per-layer-per-microbatch groups of the serial
+    oracle, so equality is exact."""
+    S, V, M = 2, 2, 2
+    mesh = make_mesh(devices8[:S], stage=S)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    staged = llama.split_blocks_interleaved(params, S, V)
+    loss = make_interleaved_pipeline_loss(MOE_CFG, mesh, M, V)
+    np.testing.assert_allclose(
+        float(jax.jit(loss)(staged, tokens)),
+        float(serial_moe_loss(params, tokens, M)),
+        rtol=1e-5,
+    )
+    g = jax.jit(jax.grad(loss))(staged, tokens)
+    g_serial = jax.grad(lambda p: serial_moe_loss(p, tokens, M))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_interleaved(g),
     )
