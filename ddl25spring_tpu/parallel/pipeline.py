@@ -49,13 +49,23 @@ Params = dict[str, Any]
 # [num_stages] dim sharded over the stage axis; embed/unembed replicated
 # (cheap relative to blocks; the FLOPs live in the MXU matmuls).
 def staged_param_specs(
-    stage_axis: str = "stage", ep_axis: str | None = None
+    stage_axis: str = "stage",
+    ep_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> Params:
     """``ep_axis``: additionally shard the switch-MoE expert stacks over
     that axis (dim 2 of the ``[S, L/S, E, ...]`` stacks) — expert
     parallelism riding the pipeline's data axis, so each device holds
     ``E/n`` experts per stage instead of all ``E`` (see
-    :func:`make_pipeline_loss`)."""
+    :func:`make_pipeline_loss`).
+
+    ``tp_axis``: additionally Megatron-shard each block's matmuls over
+    that axis — wq/wk/wv/w_gate/w_up column-split (last dim), wo/w_down
+    row-split (dim 2 of ``[S, Lc, d, d]``) — the layout
+    :mod:`ddl25spring_tpu.parallel.tp` uses, lifted onto staged blocks
+    for the 3-D DP x PP x TP composition."""
+    if ep_axis is not None and tp_axis is not None:
+        raise NotImplementedError("ep_axis and tp_axis are exclusive")
     blocks: Any = P(stage_axis)
     if ep_axis is not None:
         blocks = {k: P(stage_axis) for k in llama.ATTN_BLOCK_KEYS}
@@ -64,6 +74,16 @@ def staged_param_specs(
             "w_gate": P(stage_axis, None, ep_axis),
             "w_up": P(stage_axis, None, ep_axis),
             "w_down": P(stage_axis, None, ep_axis),
+        }
+    elif tp_axis is not None:
+        # single source of which weights are column- vs row-parallel:
+        # parallel.tp's constants, lifted onto the [S, Lc, d, d] stacks
+        from ddl25spring_tpu.parallel.tp import _COL, _ROW
+
+        blocks = {
+            "ln1": P(stage_axis), "ln2": P(stage_axis),
+            **{k: P(stage_axis, None, None, tp_axis) for k in _COL},
+            **{k: P(stage_axis, None, tp_axis, None) for k in _ROW},
         }
     return {
         "embed": P(),
@@ -82,6 +102,7 @@ def make_pipeline_loss(
     remat: bool = False,
     ep_axis: str | None = None,
     num_chunks: int = 1,
+    tp_axis: str | None = None,
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
 
@@ -121,6 +142,17 @@ def make_pipeline_loss(
     see :func:`make_interleaved_pipeline_loss` for the schedule design;
     this function is the single implementation of both (``V == 1``
     reduces the slot map to plain GPipe).
+
+    ``tp_axis``: Megatron tensor parallelism INSIDE each stage — the
+    full 3-D DP x PP x TP composition.  Block matmuls are column/row
+    sharded over the axis (``staged_param_specs(tp_axis=...)``) and each
+    block pays the two psums of :func:`~ddl25spring_tpu.models.llama.
+    block_forward`; embed/unembed stay replicated (cheap at the workload
+    dmodel; the vocab-sharded head lives in :mod:`parallel.tp`).  Every
+    TP member computes the identical loss (psums complete each matmul),
+    so the final ``pmean`` over the axis only normalizes the varying
+    type — and its transpose restores each member's full cotangent,
+    making sharded-weight grads exact (pinned vs serial in tests).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
@@ -136,6 +168,24 @@ def make_pipeline_loss(
             raise ValueError(
                 f"interleaved schedule needs microbatches ({M}) divisible "
                 f"by stages ({S})"
+            )
+    if tp_axis is not None:
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "switch-MoE under pipeline TP is not wired; use EP "
+                "(ep_axis) or TP-only (parallel.tp.make_tp_moe_fn)"
+            )
+        if V > 1:
+            raise NotImplementedError(
+                "pipeline TP assumes the 4-d [S, Lc, d, d] gpipe block "
+                "layout; the interleaved [S, V, Lc, d, d] stacks would "
+                "silently shard the wrong matmul dim"
+            )
+        t = mesh.shape[tp_axis]
+        if cfg.num_heads % t:
+            raise ValueError(
+                f"num_heads ({cfg.num_heads}) not divisible by "
+                f"{tp_axis}={t}"
             )
 
     moe_fn = None
@@ -170,14 +220,18 @@ def make_pipeline_loss(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(staged_param_specs(stage_axis, ep_axis), tok_spec),
+        in_specs=(staged_param_specs(stage_axis, ep_axis, tp_axis), tok_spec),
         out_specs=P(),
     )
     def pipelined(params: Params, tokens_mb: jax.Array) -> jax.Array:
         local_blocks = jax.tree.map(lambda x: x[0], params["blocks"])
         s = lax.axis_index(stage_axis)
         mb, L = tokens_mb.shape[1], tokens_mb.shape[2]
-        axes = (stage_axis,) + ((data_axis,) if data_axis else ())
+        axes = (
+            (stage_axis,)
+            + ((data_axis,) if data_axis else ())
+            + ((tp_axis,) if tp_axis else ())
+        )
 
         # Varying copies of the embed/unembed params, cast OUTSIDE the scan:
         # their cotangent psum (the transpose of this pcast) then executes
@@ -229,7 +283,7 @@ def make_pipeline_loss(
                 w_f = jnp.where(active, 1.0, 0.0).astype(jnp.float32)
                 aux_term = w_f * jnp.float32(cfg.moe_aux_weight) * aux
             else:
-                x_out = llama.apply_blocks(chunk, x_in, cfg)
+                x_out = llama.apply_blocks(chunk, x_in, cfg, tp_axis=tp_axis)
                 aux_term = jnp.float32(0.0)
 
             # the last (virtual) stage finishes microbatch m on this tick.
@@ -265,6 +319,11 @@ def make_pipeline_loss(
         total = lax.psum(loss_sum, stage_axis) / M
         if data_axis is not None:
             total = lax.pmean(total, data_axis)
+        if tp_axis is not None:
+            # every TP member computed the identical loss (psums complete
+            # each matmul); the pmean normalizes the varying type, and its
+            # transpose restores each member's full cotangent
+            total = lax.pmean(total, tp_axis)
         return total
 
     def loss(params: Params, tokens: jax.Array) -> jax.Array:
@@ -692,6 +751,7 @@ def make_pipeline_train_step(
     schedule: str = "gpipe",
     ep_axis: str | None = None,
     num_chunks: int = 1,
+    tp_axis: str | None = None,
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -717,6 +777,12 @@ def make_pipeline_train_step(
             raise NotImplementedError(
                 "EP expert sharding rides the gpipe schedule only"
             )
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "pipeline TP rides the plain gpipe schedule; the TP "
+                "param specs assume the 4-d [S, Lc, d, d] block layout, "
+                "not the interleaved [S, V, Lc, d, d]"
+            )
         loss_fn = make_interleaved_pipeline_loss(
             cfg, mesh, num_microbatches, num_chunks, stage_axis, data_axis,
         )
@@ -730,6 +796,11 @@ def make_pipeline_train_step(
                 "in non-uniform control flow — keep experts replicated "
                 "under 1F1B"
             )
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "pipeline TP rides the gpipe schedule; the hand-rolled "
+                "1F1B backward does not thread the TP psums"
+            )
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="residuals" if schedule == "1f1b-stash" else "input",
@@ -737,7 +808,7 @@ def make_pipeline_train_step(
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
-            ep_axis=ep_axis,
+            ep_axis=ep_axis, tp_axis=tp_axis,
         )
         vag = jax.value_and_grad(loss_fn)
     else:
@@ -829,13 +900,16 @@ def shard_staged_params(
     mesh: Mesh,
     stage_axis: str = "stage",
     ep_axis: str | None = None,
+    tp_axis: str | None = None,
 ):
     """Place staged params on the mesh: blocks sharded over the stage axis,
     the rest replicated — each device holds only its stages' layers, like
     each reference rank building only its own ``LLamaStage``.  With
     ``ep_axis``, the expert stacks additionally shard over that axis
-    (each device then holds only ``E/n`` experts of its stages)."""
-    specs = staged_param_specs(stage_axis, ep_axis)
+    (each device then holds only ``E/n`` experts of its stages); with
+    ``tp_axis``, block matmuls additionally column/row-shard over it
+    (DP x PP x TP)."""
+    specs = staged_param_specs(stage_axis, ep_axis, tp_axis)
     blocks_spec = specs["blocks"]
     if isinstance(blocks_spec, P):
         blocks = jax.tree.map(
