@@ -94,6 +94,7 @@ def _block_decode(p: Params, x, k_cache, v_cache, pos, cos, sin,
         y, _ = moe_ffn(
             p["moe"], h.reshape(B, -1),
             capacity_factor=float(p["moe"]["router"].shape[1]),
+            top_k=cfg.moe_top_k,
         )
         x = x + y.reshape(B, 1, -1).astype(dtype)
     else:

@@ -208,7 +208,10 @@ def block_forward(
             from ddl25spring_tpu.parallel.ep import moe_ffn
 
             def moe_fn(mp, flat):
-                return moe_ffn(mp, flat, capacity_factor=cfg.capacity_factor)
+                return moe_ffn(
+                    mp, flat, capacity_factor=cfg.capacity_factor,
+                    top_k=cfg.moe_top_k,
+                )
 
         # tokens flattened [B*L, D]: ONE dispatch group per call, so under
         # capacity overflow a token's drop decision depends on the other

@@ -67,30 +67,65 @@ def _expert_ffn(p: Params, x: jax.Array) -> jax.Array:
     return jnp.einsum("ecf,efd->ecd", gate * up, p["w_down"].astype(dtype))
 
 
-def _dispatch_tensors(router_logits: jax.Array, capacity: int):
-    """Switch dispatch: one-hot ``[T, E, C]`` dispatch mask and gate-weighted
-    combine tensor, plus the load-balancing auxiliary loss."""
+def _dispatch_tensors(
+    router_logits: jax.Array, capacity: int, top_k: int = 1
+):
+    """Routed dispatch: one-hot ``[T, E, C]`` dispatch mask and
+    gate-weighted combine tensor, plus the load-balancing auxiliary loss.
+
+    ``top_k == 1`` is switch routing (gate = the winning softmax prob);
+    ``top_k > 1`` is Mixtral-style top-k routing: each token dispatches to
+    its k highest-prob experts with gates renormalized over the k choices,
+    and bucket slots fill CHOICE-MAJOR (every token's first choice before
+    any second choice), so under overflow second choices drop first — the
+    GShard discipline.  The aux loss stays the Switch estimator on
+    first-choice assignments in both cases."""
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    gate = jnp.max(probs, axis=-1)                    # [T]
-    expert = jnp.argmax(probs, axis=-1)               # [T]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
-    # position of each token within its expert's bucket (arrival order)
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # [T, E]
-    keep = onehot * (pos < capacity)                       # overflow drops
-    disp = keep[:, :, None] * jax.nn.one_hot(
-        pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32
-    )[:, None, :]                                          # [T, E, C]
-    combine = disp * gate[:, None, None]
+    if top_k == 1:
+        gate = jnp.max(probs, axis=-1)                    # [T]
+        expert = jnp.argmax(probs, axis=-1)               # [T]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+        # position of each token within its expert's bucket (arrival order)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # [T, E]
+        keep = onehot * (pos < capacity)                       # overflow drops
+        disp = keep[:, :, None] * jax.nn.one_hot(
+            pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32
+        )[:, None, :]                                          # [T, E, C]
+        combine = disp * gate[:, None, None]
+        first_choice = onehot
+        kept = keep.sum(0)
+    else:
+        gates, experts = lax.top_k(probs, top_k)          # [T, k]
+        gates = gates / jnp.maximum(
+            gates.sum(-1, keepdims=True), 1e-9
+        )                                                  # renormalize
+        onehots = jax.nn.one_hot(experts, E, dtype=jnp.float32)  # [T, k, E]
+        # choice-major arrival order: flatten [k, T, E] so cumsum fills
+        # all first choices before any second choice
+        oh_flat = onehots.transpose(1, 0, 2).reshape(top_k * T, E)
+        pos = (jnp.cumsum(oh_flat, axis=0) - 1.0) * oh_flat
+        keep = oh_flat * (pos < capacity)
+        disp_flat = keep[:, :, None] * jax.nn.one_hot(
+            pos.sum(-1).astype(jnp.int32), capacity, dtype=jnp.float32
+        )[:, None, :]                                      # [kT, E, C]
+        disp_k = disp_flat.reshape(top_k, T, E, capacity)
+        # each (t, e) pair appears in at most one choice (top_k experts
+        # are distinct), so the sums below never collide slots
+        disp = disp_k.sum(0)
+        combine = (
+            disp_k * gates.T[:, :, None, None]
+        ).sum(0)
+        first_choice = onehots[:, 0]
+        kept = keep.reshape(top_k, T, E).sum((0, 1))
     # Switch aux loss: E * sum_e fraction_e * mean-prob_e.  fraction_e is
-    # the ASSIGNED fraction (pre-drop routing decisions), not the kept
-    # fraction — keep.sum(0) saturates at C under overflow, which would
+    # the ASSIGNED first-choice fraction (pre-drop routing decisions), not
+    # the kept fraction — kept saturates at C under overflow, which would
     # under-penalize imbalance exactly when drops occur
-    frac = onehot.sum(0) / jnp.maximum(onehot.sum(), 1.0)
+    frac = first_choice.sum(0) / jnp.maximum(first_choice.sum(), 1.0)
     aux = E * jnp.sum(frac * probs.mean(0))
     # kept-token count per expert [E] (dropped = assigned - kept): the
     # overflow accounting the EP/dense equivalence tests pin
-    kept = keep.sum(0)
     return disp, combine, aux, kept
 
 
@@ -99,21 +134,25 @@ def moe_ffn(
     x: jax.Array,
     capacity_factor: float = 1.25,
     return_stats: bool = False,
+    top_k: int = 1,
 ):
     """Single-device reference MoE: ``x [T, D] -> ([T, D], aux_loss)``.
 
-    ``return_stats=True`` appends ``{"kept": [E], "assigned": T}`` so
-    callers can account dropped tokens (``T - kept.sum()``)."""
+    ``return_stats=True`` appends ``{"kept": [E], "assigned": T * top_k}``
+    — both counts are SLOT assignments (a token makes ``top_k`` routing
+    decisions), so dropped slots = ``assigned - kept.sum()`` for every k.
+    ``top_k``: experts per token (1 = switch, 2 = Mixtral-style; see
+    :func:`_dispatch_tensors`); capacity scales with k."""
     T, D = x.shape
     E = p["router"].shape[1]
-    C = max(1, int(T * capacity_factor / E))
+    C = max(1, int(T * capacity_factor * top_k / E))
     logits = x.astype(jnp.float32) @ p["router"]
-    disp, combine, aux, kept = _dispatch_tensors(logits, C)
+    disp, combine, aux, kept = _dispatch_tensors(logits, C, top_k)
     expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
     expert_out = _expert_ffn(p, expert_in)
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
     if return_stats:
-        return y, aux, {"kept": kept, "assigned": jnp.float32(T)}
+        return y, aux, {"kept": kept, "assigned": jnp.float32(T * top_k)}
     return y, aux
 
 
@@ -126,6 +165,7 @@ def ep_moe_local(
     capacity_factor: float = 1.25,
     vary_axes: tuple[str, ...] = (),
     return_stats: bool = False,
+    top_k: int = 1,
 ):
     """The expert-parallel MoE body, for use INSIDE an enclosing
     ``shard_map``: ``x [T_local, D]`` is this shard's token slice along
@@ -143,12 +183,12 @@ def ep_moe_local(
     T_local, D = x.shape
     E = p["router"].shape[1]          # global expert count
     E_local = E // ep
-    C = max(1, int(T_local * capacity_factor / E))
+    C = max(1, int(T_local * capacity_factor * top_k / E))
     router = p["router"]
     if vary_axes:
         router = lax.pcast(router, vary_axes, to="varying")
     logits = x.astype(jnp.float32) @ router
-    disp, combine, aux, kept = _dispatch_tensors(logits, C)
+    disp, combine, aux, kept = _dispatch_tensors(logits, C, top_k)
 
     expert_in = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), x)
     # regroup [E, C, D] = [ep, E_local, C, D]: hand shard s's buckets
@@ -179,6 +219,7 @@ def make_ep_moe_fn(
     capacity_factor: float = 1.25,
     return_stats: bool = False,
     data_axis: str | None = None,
+    top_k: int = 1,
 ):
     """EP-sharded MoE: tokens AND experts sharded over ``mesh[axis]``.
 
@@ -195,8 +236,9 @@ def make_ep_moe_fn(
     gradients psum over ``data_axis`` automatically, since the stacks are
     data-invariant inputs under ``shard_map`` autodiff).
 
-    ``return_stats=True`` appends ``{"kept": [E], "assigned": T_global}``
-    (psum over shards).  Because each shard dispatches its own token group
+    ``return_stats=True`` appends ``{"kept": [E], "assigned":
+    T_global * top_k}`` (psum over shards; slot accounting as in
+    :func:`moe_ffn`).  Because each shard dispatches its own token group
     with capacity ``T_local*cf/E``, the kept counts equal the dense
     :func:`moe_ffn` run per shard group — pinned in ``tests/test_ep.py``.
     """
@@ -223,7 +265,7 @@ def make_ep_moe_fn(
         vary_axes = (axis,) + ((data_axis,) if data_axis else ())
         res = ep_moe_local(
             p, x, axis=axis, ep=ep, capacity_factor=capacity_factor,
-            vary_axes=vary_axes, return_stats=return_stats,
+            vary_axes=vary_axes, return_stats=return_stats, top_k=top_k,
         )
         # aux is the mean of per-shard switch losses (each over its token
         # shard) — the standard sharded-MoE estimator; it converges to the
@@ -236,7 +278,9 @@ def make_ep_moe_fn(
             n_shards = ep * (mesh.shape[data_axis] if data_axis else 1)
             stats = {
                 "kept": lax.psum(kept, vary_axes),
-                "assigned": jnp.float32(x.shape[0] * n_shards),
+                # slot assignments (T_global routing decisions x top_k),
+                # matching moe_ffn's accounting for every k
+                "assigned": jnp.float32(x.shape[0] * n_shards * top_k),
             }
             return y, lax.pmean(aux, vary_axes), stats
         y, aux = res

@@ -212,7 +212,7 @@ def make_pipeline_loss(
             return ep_moe_local(
                 mp, flat, axis=ep_axis, ep=ep_n,
                 capacity_factor=cfg.capacity_factor,
-                vary_axes=(ep_axis,),
+                vary_axes=(ep_axis,), top_k=cfg.moe_top_k,
             )
 
     tok_spec = P(None, data_axis)  # [M, mb, L]: shard microbatch dim over data

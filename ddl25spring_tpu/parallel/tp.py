@@ -164,7 +164,11 @@ def vocab_sharded_lm_loss(
     return lax.pmean((logz - picked).mean(), axis)
 
 
-def make_tp_moe_fn(model_axis: str = "model", capacity_factor: float = 1.25):
+def make_tp_moe_fn(
+    model_axis: str = "model",
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+):
     """Switch-MoE FFN for use inside the TP ``shard_map``: expert stacks
     sharded over the model axis, tokens replicated across it.
 
@@ -182,9 +186,9 @@ def make_tp_moe_fn(model_axis: str = "model", capacity_factor: float = 1.25):
         T, D = x.shape
         E = mp["router"].shape[1]           # global expert count
         E_local = mp["w_gate"].shape[0]     # this shard's slice
-        C = max(1, int(T * capacity_factor / E))
+        C = max(1, int(T * capacity_factor * top_k / E))
         logits = x.astype(jnp.float32) @ mp["router"]
-        disp, combine, aux, _ = _dispatch_tensors(logits, C)
+        disp, combine, aux, _ = _dispatch_tensors(logits, C, top_k)
         e0 = lax.axis_index(model_axis) * E_local
         disp_l = lax.dynamic_slice_in_dim(disp, e0, E_local, axis=1)
         comb_l = lax.dynamic_slice_in_dim(combine, e0, E_local, axis=1)
@@ -209,7 +213,7 @@ def make_tp_loss(
     Switch-MoE configs ride the same axis via :func:`make_tp_moe_fn`, with
     the load-balancing aux loss folded in at ``cfg.moe_aux_weight``."""
     moe_fn = (
-        make_tp_moe_fn(model_axis, cfg.capacity_factor)
+        make_tp_moe_fn(model_axis, cfg.capacity_factor, cfg.moe_top_k)
         if cfg.n_experts > 0 else None
     )
 

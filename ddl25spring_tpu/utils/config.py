@@ -27,6 +27,7 @@ class LlamaConfig:
     n_experts: int = 0          # > 0: switch-MoE FFN in every block
     capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance aux loss weight
+    moe_top_k: int = 1          # experts/token: 1 = switch, 2 = Mixtral-style
 
     @property
     def head_dim(self) -> int:

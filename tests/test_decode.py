@@ -54,12 +54,15 @@ def test_greedy_generate_equals_full_forward(params_and_prompt):
     np.testing.assert_array_equal(got, want)
 
 
-def test_greedy_generate_moe(params_and_prompt):
-    """MoE blocks decode too: with ample capacity the per-token top-1
-    routing is group-independent, so the oracle still holds exactly."""
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_greedy_generate_moe(params_and_prompt, top_k):
+    """MoE blocks decode too: with ample capacity the per-token routing
+    (top-1 switch AND top-2) is group-independent, so the oracle still
+    holds exactly — a decode path that dropped ``moe_top_k`` would route
+    top-1 and silently diverge from the trained forward."""
     cfg = LlamaConfig(
         vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=32,
-        dtype="float32", n_experts=4, capacity_factor=4.0,
+        dtype="float32", n_experts=4, capacity_factor=4.0, moe_top_k=top_k,
     )
     params = llama.init_llama_params(jax.random.PRNGKey(2), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 1, 64)

@@ -227,3 +227,135 @@ def test_moe_llama_with_ep_moe_fn(devices8):
     # finite and in the same ballpark as the reference
     assert np.isfinite(float(ep_aux))
     np.testing.assert_allclose(float(ref_aux), float(ep_aux), rtol=0.25)
+
+
+# ---------------------------------------------------------------- top-k
+
+
+def test_top2_matches_explicit_expert_sum(setup):
+    """top_k=2 with ample capacity ≡ the literal definition: for every
+    token, the renormalized-gate-weighted sum of its two highest-prob
+    experts' FFN outputs."""
+    p, x = setup
+    y, aux = jax.jit(
+        lambda p, x: moe_ffn(p, x, capacity_factor=float(E), top_k=2)
+    )(p, x)
+
+    probs = jax.nn.softmax(x @ p["router"], axis=-1)
+    gates, experts = jax.lax.top_k(probs, 2)
+    gates = gates / gates.sum(-1, keepdims=True)
+    per_expert = jnp.stack([
+        jax.nn.silu(x @ p["w_gate"][e]) * (x @ p["w_up"][e]) @ p["w_down"][e]
+        for e in range(E)
+    ])  # [E, T, D]
+    expect = jnp.zeros_like(x)
+    for j in range(2):
+        expect = expect + gates[:, j:j + 1] * jnp.take_along_axis(
+            per_expert, experts[:, j][None, :, None], axis=0
+        )[0]
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(expect), atol=1e-5, rtol=1e-4
+    )
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_top1_unchanged_by_topk_plumbing(setup):
+    """top_k=1 must remain the exact switch path."""
+    p, x = setup
+    y1, aux1 = jax.jit(lambda p, x: moe_ffn(p, x, 2.0))(p, x)
+    y2, aux2 = jax.jit(lambda p, x: moe_ffn(p, x, 2.0, top_k=1))(p, x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert float(aux1) == float(aux2)
+
+
+@pytest.mark.parametrize("ep", [2, 4])
+def test_ep_top2_equals_dense(setup, ep, devices8):
+    """EP-sharded top-2 ≡ dense top-2 at ample capacity (the a2a dispatch
+    carries two bucket slots per token now)."""
+    p, x = setup
+    mesh = make_mesh(devices8[:ep], expert=ep)
+    y_ref, aux_ref = jax.jit(
+        lambda p, x: moe_ffn(p, x, float(E), top_k=2)
+    )(p, x)
+    f = make_ep_moe_fn(mesh, capacity_factor=float(E), top_k=2)
+    y_ep, aux_ep = jax.jit(f)(shard_moe_params(p, mesh), x)
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_ep), atol=1e-6, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(aux_ref), float(aux_ep), rtol=5e-3)
+
+
+def test_top2_overflow_drops_second_choices_first(setup):
+    """Choice-major bucket filling (GShard discipline): EVERY first
+    choice outranks every second choice for bucket slots.  The oracle is
+    a crafted 4-token, 2-expert, C=2 case where choice-major and
+    token-major filling disagree: t0 arrives first but wants expert A
+    only as its SECOND choice, while t1..t3 want A first — so A's two
+    slots must go to t1, t2 (first-choicers, arrival order), NOT t0."""
+    from ddl25spring_tpu.parallel.ep import _dispatch_tensors
+
+    A, B = 0, 1
+    logits = jnp.array([
+        [2.0, 5.0],   # t0: first B, second A
+        [5.0, 2.0],   # t1: first A, second B
+        [5.0, 2.0],   # t2: first A, second B
+        [5.0, 2.0],   # t3: first A, second B
+    ])
+    disp, combine, aux, kept = _dispatch_tensors(logits, 2, top_k=2)
+    disp = np.asarray(disp)
+    # expert A slots: t1, t2 (first choices beat t0's earlier-arriving
+    # second choice); t3's first choice overflows
+    assert disp[0, A].sum() == 0  # token-major filling would make this 1
+    assert disp[1, A].sum() == 1 and disp[2, A].sum() == 1
+    assert disp[3, A].sum() == 0
+    # expert B slots: t0 (first choice) + t1's second choice; t2/t3 drop
+    assert disp[0, B].sum() == 1 and disp[1, B].sum() == 1
+    assert disp[2, B].sum() == 0 and disp[3, B].sum() == 0
+    np.testing.assert_array_equal(np.asarray(kept), [2.0, 2.0])
+
+    # and the slot accounting stays non-negative under overflow at the
+    # moe_ffn level: assigned = T*k slots, dropped = assigned - kept
+    p, x = setup
+    y, aux2, stats = jax.jit(
+        lambda p, x: moe_ffn(p, x, 0.5, return_stats=True, top_k=2)
+    )(p, x)
+    C = max(1, int(T * 0.5 * 2 / E))
+    kept2 = np.asarray(stats["kept"])
+    assert (kept2 <= C).all()
+    assert float(stats["assigned"]) == 2 * T
+    assert float(stats["assigned"]) - kept2.sum() > 0  # genuine drops
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_top2_llama_trains(devices8):
+    """A top-2 MoE LLaMA config trains end-to-end through the aux-weighted
+    composite loss."""
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32", n_experts=4, capacity_factor=2.0, moe_top_k=2,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+
+    def loss_fn(p):
+        logits, aux = llama.llama_forward_with_aux(p, tokens, cfg)
+        return causal_lm_loss(logits, tokens) + cfg.moe_aux_weight * aux
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return loss, optax.apply_updates(p, updates), o
+
+    losses = []
+    for _ in range(20):
+        loss, params, opt = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
