@@ -64,9 +64,17 @@ def parse_args(argv=None):
                     const="stream", help="alias for --input stream")
     ap.add_argument("--no-stream", dest="input", action="store_const",
                     const="fixed", help="alias for --input fixed")
-    ap.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+    ap.add_argument("--schedule",
+                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved"),
+                    default="gpipe",
                     help="llama: pipeline schedule (1f1b bounds activation "
-                         "memory at O(S) instead of O(M))")
+                         "memory at O(S) instead of O(M); 1f1b-stash is the "
+                         "non-remat variant; interleaved chunks each stage "
+                         "into --chunks virtual stages, bubble ~/V)")
+    ap.add_argument("--chunks", type=int, default=2, metavar="V",
+                    help="llama interleaved schedule: layer chunks per "
+                         "device (needs microbatches %% stages == 0 and "
+                         "n_layers %% (stages*V) == 0)")
     ap.add_argument("--no-flash", action="store_true",
                     help="llama: disable the Pallas flash-attention kernel "
                          "(ON by default on TPU; CPU always runs dense)")
@@ -119,13 +127,21 @@ def run_llama(args, jax, jnp):
           f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
-    staged = shard_staged_params(llama.split_blocks_for_stages(params, S), mesh)
+    if args.schedule == "interleaved":
+        split = lambda p: llama.split_blocks_interleaved(p, S, args.chunks)
+    else:
+        split = lambda p: llama.split_blocks_for_stages(p, S)
+    staged = shard_staged_params(split(params), mesh)
     tx = optax.adam(args.lr or 8e-4)
     opt_state = tx.init(staged)
-    step = make_pipeline_train_step(
-        cfg, tx, mesh, M, data_axis="data" if dp > 1 else None,
-        schedule=args.schedule,
-    )
+
+    def build_step(c):
+        return make_pipeline_train_step(
+            c, tx, mesh, M, data_axis="data" if dp > 1 else None,
+            schedule=args.schedule, num_chunks=args.chunks,
+        )
+
+    step = build_step(cfg)
 
     start_it = 0
     ckpt = None
@@ -156,12 +172,7 @@ def run_llama(args, jax, jnp):
 
     tokens_w = jnp.asarray(next(ds))
     _, step, cfg = warmup_with_flash_fallback(
-        cfg,
-        lambda c: make_pipeline_train_step(
-            c, tx, mesh, M, data_axis="data" if dp > 1 else None,
-            schedule=args.schedule,
-        ),
-        step, staged, opt_state, tokens_w,
+        cfg, build_step, step, staged, opt_state, tokens_w,
     )
     float(_[2])
 
