@@ -93,6 +93,20 @@ def staged_param_specs(
     }
 
 
+def _check_tp(cfg: LlamaConfig, mesh: Mesh, tp_axis: str) -> None:
+    """Shared TP preconditions for the pipeline schedules."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "switch-MoE under pipeline TP is not wired; use EP "
+            "(ep_axis) or TP-only (parallel.tp.make_tp_moe_fn)"
+        )
+    t = mesh.shape[tp_axis]
+    if cfg.num_heads % t:
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) not divisible by {tp_axis}={t}"
+        )
+
+
 def make_pipeline_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -170,22 +184,12 @@ def make_pipeline_loss(
                 f"by stages ({S})"
             )
     if tp_axis is not None:
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "switch-MoE under pipeline TP is not wired; use EP "
-                "(ep_axis) or TP-only (parallel.tp.make_tp_moe_fn)"
-            )
+        _check_tp(cfg, mesh, tp_axis)
         if V > 1:
             raise NotImplementedError(
-                "pipeline TP assumes the 4-d [S, Lc, d, d] gpipe block "
-                "layout; the interleaved [S, V, Lc, d, d] stacks would "
-                "silently shard the wrong matmul dim"
-            )
-        t = mesh.shape[tp_axis]
-        if cfg.num_heads % t:
-            raise ValueError(
-                f"num_heads ({cfg.num_heads}) not divisible by "
-                f"{tp_axis}={t}"
+                "pipeline TP assumes the 4-d [S, Lc, d, d] gpipe/1f1b "
+                "block layout; the interleaved [S, V, Lc, d, d] stacks "
+                "would silently shard the wrong matmul dim"
             )
 
     moe_fn = None
@@ -397,6 +401,7 @@ def make_1f1b_value_and_grad(
     stage_axis: str = "stage",
     data_axis: str | None = None,
     stash: str = "input",
+    tp_axis: str | None = None,
 ):
     """1F1B: the memory-bounded pipeline schedule, hand-rolled backward.
 
@@ -461,35 +466,40 @@ def make_1f1b_value_and_grad(
     M = num_microbatches
     dtype = jnp.dtype(cfg.dtype)
     K = 2 * S - 1  # ring slots; slot K is scratch for inactive ticks
+    if tp_axis is not None:
+        _check_tp(cfg, mesh, tp_axis)
 
     tok_spec = P(None, data_axis)
-    grad_out_specs = {
-        "embed": P(),
-        "blocks": P(stage_axis),
-        "ln_f": P(),
-        "unembed": P(),
-    }
+    # one spec tree serves both sides: param grads come back in the same
+    # layout the params go in
+    param_specs = staged_param_specs(stage_axis, tp_axis=tp_axis)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(staged_param_specs(stage_axis), tok_spec),
-        out_specs=(P(), grad_out_specs),
+        in_specs=(param_specs, tok_spec),
+        out_specs=(P(), param_specs),
     )
     def value_and_grad(params: Params, tokens_mb: jax.Array):
         local_blocks = jax.tree.map(lambda x: x[0], params["blocks"])
         s = lax.axis_index(stage_axis)
         mb, L = tokens_mb.shape[1], tokens_mb.shape[2]
-        axes = (stage_axis,) + ((data_axis,) if data_axis else ())
+        axes = (
+            (stage_axis,)
+            + ((data_axis,) if data_axis else ())
+            + ((tp_axis,) if tp_axis else ())
+        )
 
         head = lax.pcast(
             {k: params[k] for k in ("embed", "ln_f", "unembed")},
             axes,
             to="varying",
         )
-        vblocks = lax.pcast(local_blocks, tuple(
-            a for a in axes if a != stage_axis
-        ), to="varying") if data_axis else local_blocks
+        # blocks are varying over stage (and tp, when sharded) already;
+        # only the data axis needs the explicit pcast
+        vblocks = lax.pcast(
+            local_blocks, (data_axis,), to="varying"
+        ) if data_axis else local_blocks
 
         is_last = s == S - 1
 
@@ -512,7 +522,7 @@ def make_1f1b_value_and_grad(
                 )
                 aux_term = jnp.float32(cfg.moe_aux_weight) * aux
             else:
-                x_out = llama.apply_blocks(blocks, x_in, cfg)
+                x_out = llama.apply_blocks(blocks, x_in, cfg, tp_axis=tp_axis)
                 aux_term = jnp.float32(0.0)
             loss = lax.cond(
                 is_last,
@@ -540,7 +550,9 @@ def make_1f1b_value_and_grad(
             # backward below; skip the dead compute
             x_out = lax.cond(
                 jnp.logical_and(fwd_active, jnp.logical_not(is_last)),
-                lambda x: llama.apply_blocks(local_blocks, x, cfg),
+                lambda x: llama.apply_blocks(
+                    local_blocks, x, cfg, tp_axis=tp_axis
+                ),
                 lambda x: x,
                 x_in,
             )
@@ -720,6 +732,34 @@ def make_1f1b_value_and_grad(
         gblocks = jax.tree.map(lambda g: g[None] / M, gblocks)
         ghead = jax.tree.map(lambda g: g / M, ghead)
         ghead = jax.tree.map(lambda g: lax.psum(g, stage_axis), ghead)
+        if tp_axis is not None:
+            # the uniform 1.0 seed on every TP member differentiates the
+            # SUM of t identical loss copies (each member's loss depends on
+            # every member's weight slice through the in-block psums, and
+            # the cooperative vjp assembles the full cross-member flow
+            # locally), so every hand-accumulated grad is t x the true
+            # gradient.  Normalization (what the GPipe TP path gets from
+            # its final pmean's transpose automatically, measured leaf by
+            # leaf against the serial model): the head grads carry
+            # per-member PARTIALS -> pmean (= psum/t); the tp-sharded
+            # matmul slices and the block norm scales are already fully
+            # assembled on every member by the cooperative vjp (the
+            # in-block psum transposes hand each member the complete
+            # downstream flow) -> scale by 1/t, with the norm scales
+            # additionally pmean-re-typed (identical across members, but
+            # their P(stage) out_spec needs the static invariance)
+            t = lax.psum(1, tp_axis)
+            loss = lax.pmean(loss, tp_axis)
+            gblocks = {
+                k: jax.tree.map(
+                    (lambda g: lax.pmean(g / t, tp_axis))
+                    if k in ("ln1", "ln2")
+                    else (lambda g: g / t),
+                    v,
+                )
+                for k, v in gblocks.items()
+            }
+            ghead = jax.tree.map(lambda g: lax.pmean(g, tp_axis), ghead)
         if data_axis is not None:
             loss = lax.pmean(loss, data_axis)
             gblocks = jax.tree.map(lambda g: lax.pmean(g, data_axis), gblocks)
@@ -771,6 +811,10 @@ def make_pipeline_train_step(
     ``ep_axis``: shard the MoE expert stacks over the data axis too
     (EP x DP x PP, gpipe schedule only — see :func:`make_pipeline_loss`);
     pass params through ``shard_staged_params(..., ep_axis=...)``.
+
+    ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on the
+    ``gpipe``, ``1f1b``, and ``1f1b-stash`` schedules; pass params
+    through ``shard_staged_params(..., tp_axis=...)``.
     """
     if schedule == "interleaved":
         if ep_axis is not None:
@@ -779,8 +823,8 @@ def make_pipeline_train_step(
             )
         if tp_axis is not None:
             raise NotImplementedError(
-                "pipeline TP rides the plain gpipe schedule; the TP "
-                "param specs assume the 4-d [S, Lc, d, d] block layout, "
+                "pipeline TP rides the gpipe and 1f1b schedules; the TP "
+                "param specs assume their 4-d [S, Lc, d, d] block layout, "
                 "not the interleaved [S, V, Lc, d, d]"
             )
         loss_fn = make_interleaved_pipeline_loss(
@@ -796,14 +840,10 @@ def make_pipeline_train_step(
                 "in non-uniform control flow — keep experts replicated "
                 "under 1F1B"
             )
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "pipeline TP rides the gpipe schedule; the hand-rolled "
-                "1F1B backward does not thread the TP psums"
-            )
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="residuals" if schedule == "1f1b-stash" else "input",
+            tp_axis=tp_axis,
         )
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
