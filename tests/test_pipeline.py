@@ -681,6 +681,19 @@ def test_pipeline_tp_train_step_sharded_placement(params_and_tokens, devices8):
     assert out_spec == jax.sharding.PartitionSpec(
         "stage", None, None, "model"
     ), out_spec
+    # the 1F1B schedule accepts tp_axis through the SAME train-step
+    # builder (regression guard on the pass-through at the vag dispatch):
+    # loss == serial and the TP placement survives the optimizer step
+    step1f = make_pipeline_train_step(
+        CFG, tx, mesh, 2, data_axis="data", tp_axis="model",
+        schedule="1f1b",
+    )
+    p1f, _, loss1f = step1f(staged, tx.init(staged), tokens)
+    np.testing.assert_allclose(float(loss1f), sloss, rtol=1e-5)
+    assert p1f["blocks"]["wq"].sharding.spec == jax.sharding.PartitionSpec(
+        "stage", None, None, "model"
+    )
+
     # and the interleaved schedule refuses tp_axis instead of ignoring it
     with pytest.raises(NotImplementedError):
         make_pipeline_train_step(
