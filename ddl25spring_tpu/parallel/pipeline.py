@@ -52,6 +52,7 @@ def staged_param_specs(
     stage_axis: str = "stage",
     ep_axis: str | None = None,
     tp_axis: str | None = None,
+    chunked: bool = False,
 ) -> Params:
     """``ep_axis``: additionally shard the switch-MoE expert stacks over
     that axis (dim 2 of the ``[S, L/S, E, ...]`` stacks) — expert
@@ -61,11 +62,21 @@ def staged_param_specs(
 
     ``tp_axis``: additionally Megatron-shard each block's matmuls over
     that axis — wq/wk/wv/w_gate/w_up column-split (last dim), wo/w_down
-    row-split (dim 2 of ``[S, Lc, d, d]``) — the layout
+    row-split (the d_in dim) — the layout
     :mod:`ddl25spring_tpu.parallel.tp` uses, lifted onto staged blocks
-    for the 3-D DP x PP x TP composition."""
+    for the 3-D DP x PP x TP composition.  ``chunked=True`` targets the
+    interleaved ``[S, V, Lc, d, d]`` stacks (one more leading dim before
+    the matmul dims)."""
     if ep_axis is not None and tp_axis is not None:
         raise NotImplementedError("ep_axis and tp_axis are exclusive")
+    if ep_axis is not None and chunked:
+        # the EP specs below index the 4-d [S, Lc, E, ...] expert stacks;
+        # padding them onto 5-d interleaved stacks would silently shard
+        # the layer dim over the expert axis
+        raise NotImplementedError(
+            "EP expert sharding is not wired for the interleaved "
+            "(chunked) block layout"
+        )
     blocks: Any = P(stage_axis)
     if ep_axis is not None:
         blocks = {k: P(stage_axis) for k in llama.ATTN_BLOCK_KEYS}
@@ -77,13 +88,14 @@ def staged_param_specs(
         }
     elif tp_axis is not None:
         # single source of which weights are column- vs row-parallel:
-        # parallel.tp's constants, lifted onto the [S, Lc, d, d] stacks
+        # parallel.tp's constants, lifted onto the stacked block dims
         from ddl25spring_tpu.parallel.tp import _COL, _ROW
 
+        pad = (None,) * (2 if chunked else 1)  # [S,(V,)Lc] leading dims
         blocks = {
             "ln1": P(stage_axis), "ln2": P(stage_axis),
-            **{k: P(stage_axis, None, None, tp_axis) for k in _COL},
-            **{k: P(stage_axis, None, tp_axis, None) for k in _ROW},
+            **{k: P(stage_axis, *pad, None, tp_axis) for k in _COL},
+            **{k: P(stage_axis, *pad, tp_axis, None) for k in _ROW},
         }
     return {
         "embed": P(),
@@ -185,12 +197,6 @@ def make_pipeline_loss(
             )
     if tp_axis is not None:
         _check_tp(cfg, mesh, tp_axis)
-        if V > 1:
-            raise NotImplementedError(
-                "pipeline TP assumes the 4-d [S, Lc, d, d] gpipe/1f1b "
-                "block layout; the interleaved [S, V, Lc, d, d] stacks "
-                "would silently shard the wrong matmul dim"
-            )
 
     moe_fn = None
     if ep_axis is not None:
@@ -224,7 +230,10 @@ def make_pipeline_loss(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(staged_param_specs(stage_axis, ep_axis, tp_axis), tok_spec),
+        in_specs=(
+            staged_param_specs(stage_axis, ep_axis, tp_axis, chunked=V > 1),
+            tok_spec,
+        ),
         out_specs=P(),
     )
     def pipelined(params: Params, tokens_mb: jax.Array) -> jax.Array:
@@ -348,6 +357,7 @@ def make_interleaved_pipeline_loss(
     stage_axis: str = "stage",
     data_axis: str | None = None,
     remat: bool = False,
+    tp_axis: str | None = None,
 ):
     """Interleaved virtual-stage pipeline (Megatron-LM-style chunking).
 
@@ -390,7 +400,7 @@ def make_interleaved_pipeline_loss(
     """
     return make_pipeline_loss(
         cfg, mesh, num_microbatches, stage_axis, data_axis, remat,
-        num_chunks=num_chunks,
+        num_chunks=num_chunks, tp_axis=tp_axis,
     )
 
 
@@ -812,23 +822,18 @@ def make_pipeline_train_step(
     (EP x DP x PP, gpipe schedule only — see :func:`make_pipeline_loss`);
     pass params through ``shard_staged_params(..., ep_axis=...)``.
 
-    ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on the
-    ``gpipe``, ``1f1b``, and ``1f1b-stash`` schedules; pass params
-    through ``shard_staged_params(..., tp_axis=...)``.
+    ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on EVERY
+    schedule; pass params through ``shard_staged_params(..., tp_axis=...)``
+    (adding ``chunked=True`` for the interleaved 5-d stacks).
     """
     if schedule == "interleaved":
         if ep_axis is not None:
             raise NotImplementedError(
                 "EP expert sharding rides the gpipe schedule only"
             )
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "pipeline TP rides the gpipe and 1f1b schedules; the TP "
-                "param specs assume their 4-d [S, Lc, d, d] block layout, "
-                "not the interleaved [S, V, Lc, d, d]"
-            )
         loss_fn = make_interleaved_pipeline_loss(
             cfg, mesh, num_microbatches, num_chunks, stage_axis, data_axis,
+            tp_axis=tp_axis,
         )
         vag = jax.value_and_grad(loss_fn)
     elif schedule in ("1f1b", "1f1b-stash"):
@@ -941,6 +946,7 @@ def shard_staged_params(
     stage_axis: str = "stage",
     ep_axis: str | None = None,
     tp_axis: str | None = None,
+    chunked: bool = False,
 ):
     """Place staged params on the mesh: blocks sharded over the stage axis,
     the rest replicated — each device holds only its stages' layers, like
@@ -948,8 +954,10 @@ def shard_staged_params(
     ``ep_axis``, the expert stacks additionally shard over that axis
     (each device then holds only ``E/n`` experts of its stages); with
     ``tp_axis``, block matmuls additionally column/row-shard over it
-    (DP x PP x TP)."""
-    specs = staged_param_specs(stage_axis, ep_axis, tp_axis)
+    (DP x PP x TP).  Pass ``chunked=True`` when the params came from
+    ``split_blocks_interleaved`` (5-d ``[S, V, Lc, d, d]`` stacks) so the
+    TP specs target the matmul dims, not the extra chunk dim."""
+    specs = staged_param_specs(stage_axis, ep_axis, tp_axis, chunked)
     blocks_spec = specs["blocks"]
     if isinstance(blocks_spec, P):
         blocks = jax.tree.map(

@@ -694,12 +694,49 @@ def test_pipeline_tp_train_step_sharded_placement(params_and_tokens, devices8):
         "stage", None, None, "model"
     )
 
-    # and the interleaved schedule refuses tp_axis instead of ignoring it
-    with pytest.raises(NotImplementedError):
-        make_pipeline_train_step(
-            CFG, tx, mesh, 2, data_axis="data", tp_axis="model",
-            schedule="interleaved",
-        )
+    # the interleaved schedule composes with TP too: 5-d chunked specs
+    # (chunked=True), loss == serial, placement survives the step
+    staged_il = shard_staged_params(
+        llama.split_blocks_interleaved(params, 2, 2), mesh,
+        tp_axis="model", chunked=True,
+    )
+    assert staged_il["blocks"]["wq"].sharding.spec == (
+        jax.sharding.PartitionSpec("stage", None, None, None, "model")
+    )
+    step_il = make_pipeline_train_step(
+        CFG, tx, mesh, 2, data_axis="data", tp_axis="model",
+        schedule="interleaved", num_chunks=2,
+    )
+    p_il, _, loss_il = step_il(staged_il, tx.init(staged_il), tokens)
+    np.testing.assert_allclose(float(loss_il), sloss, rtol=1e-5)
+    assert p_il["blocks"]["wq"].sharding.spec == (
+        jax.sharding.PartitionSpec("stage", None, None, None, "model")
+    )
+
+
+def test_interleaved_tp_grads_equal_serial(params_and_tokens, devices8):
+    """Interleaved virtual stages x Megatron TP: grads ≡ serial through
+    the chunk-indexed TP blocks (the chunked 5-d specs must shard the
+    OUTPUT dim of column weights, not the input dim)."""
+    params, tokens = params_and_tokens
+    tokens = tokens[:4]
+    mesh = make_mesh(devices8[:4], stage=2, model=2)
+    staged = llama.split_blocks_interleaved(params, 2, 2)
+    loss = make_interleaved_pipeline_loss(CFG, mesh, 2, 2, tp_axis="model")
+    np.testing.assert_allclose(
+        float(jax.jit(loss)(staged, tokens)),
+        float(serial_loss(params, tokens)),
+        rtol=1e-5,
+    )
+    g = jax.jit(jax.grad(loss))(staged, tokens)
+    g_serial = jax.grad(serial_loss)(params, tokens)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_interleaved(g),
+    )
 
 
 @pytest.mark.parametrize("stash", ["input", "residuals"])
