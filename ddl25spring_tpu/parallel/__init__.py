@@ -9,6 +9,24 @@ from ddl25spring_tpu.parallel.ep import (
     moe_ffn,
     shard_moe_params,
 )
+from ddl25spring_tpu.parallel.pipeline import (
+    fuse_train_steps,
+    make_1f1b_value_and_grad,
+    make_grad_accum_step,
+    make_interleaved_pipeline_loss,
+    make_pipeline_loss,
+    make_pipeline_train_step,
+    shard_staged_params,
+)
+from ddl25spring_tpu.parallel.sp import (
+    make_sp_loss,
+    make_sp_train_step,
+)
+from ddl25spring_tpu.parallel.tp import (
+    make_tp_loss,
+    make_tp_train_step,
+    shard_tp_params,
+)
 from ddl25spring_tpu.parallel.zero import (
     make_zero_dp_train_step,
     zero_clip_by_global_norm,
@@ -24,6 +42,18 @@ __all__ = [
     "make_ep_moe_fn",
     "moe_ffn",
     "shard_moe_params",
+    "fuse_train_steps",
+    "make_1f1b_value_and_grad",
+    "make_grad_accum_step",
+    "make_interleaved_pipeline_loss",
+    "make_pipeline_loss",
+    "make_pipeline_train_step",
+    "shard_staged_params",
+    "make_sp_loss",
+    "make_sp_train_step",
+    "make_tp_loss",
+    "make_tp_train_step",
+    "shard_tp_params",
     "make_zero_dp_train_step",
     "zero_clip_by_global_norm",
     "zero_shard_params",
