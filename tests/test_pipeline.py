@@ -475,18 +475,25 @@ def test_grad_accum_equals_full_batch():
     )
 
 
-def test_fused_steps_equal_sequential(devices8):
+@pytest.mark.parametrize("schedule", ["gpipe", "interleaved"])
+def test_fused_steps_equal_sequential(schedule, devices8):
     """fuse_train_steps(step, K) on [K, B, L] stacked batches must land on
     the same params/losses as K sequential dispatches of the same step
-    (dispatch-amortization must not change semantics)."""
+    (dispatch-amortization must not change semantics) — the fusion wraps
+    ANY schedule, so both splitters/schedules share this harness."""
     from ddl25spring_tpu.parallel.pipeline import fuse_train_steps
 
     S, M, K = 2, 2, 3
     mesh = make_mesh(devices8[:S], stage=S)
     params = llama.init_llama_params(jax.random.PRNGKey(5), CFG)
-    staged = llama.split_blocks_for_stages(params, S)
+    if schedule == "interleaved":
+        staged = llama.split_blocks_interleaved(params, S, 2)
+    else:
+        staged = llama.split_blocks_for_stages(params, S)
     tx = optax.sgd(0.05)
-    step = make_pipeline_train_step(CFG, tx, mesh, M)
+    step = make_pipeline_train_step(
+        CFG, tx, mesh, M, schedule=schedule, num_chunks=2
+    )
     tokens_k = jax.random.randint(jax.random.PRNGKey(6), (K, 4, 16), 0, 64)
 
     p_seq, o_seq = staged, tx.init(staged)
