@@ -33,13 +33,29 @@ Topology: DP+PP (2-stage heterogeneous pipeline x DP) when >= 2 chips are
 attached, pure DP on a single chip — the emitted JSON names the layout it
 actually ran.
 
+A FedAvg round-time line rides in ``secondary`` too: one timed
+``make_fedavg_round`` on the tutorial_1a workload (N=10, C=0.1, B=100,
+E=1, lr=0.01, seed=10 — the reference's wall-time-accounted FedAvg round,
+``lab/tutorial_1a/hfl_complete.py:294,373``), the second metric
+BASELINE.json tracks.
+
 Driver contract: print ONE JSON line with at least
 ``{"metric", "value", "unit", "vs_baseline"}``.  Extra self-describing
 fields: ``input``, ``data`` (real vs synthetic CIFAR), ``topology``,
 ``chip``, ``mfu``, ``achieved_tflops_per_chip``, ``secondary`` (list: the
-streaming and fixed-batch runs).  If the TPU tunnel is unreachable the
-device probe times out and ONE JSON line with an ``error`` field is printed
-instead of hanging the driver.
+streaming, fixed-batch, and FedAvg runs).  If the TPU tunnel is
+unreachable the device probe times out and ONE JSON line with an
+``error`` field is printed instead of hanging the driver.
+
+**Resilience**: a failed jax backend init is sticky in-process, and the
+tunnel has flaked at capture time before (round 4 recorded ``value: 0.0``
+for a run whose builder-side numbers were fine).  So the accelerator path
+runs the whole bench in FRESH CHILD SUBPROCESSES with retries + backoff
+(default 3 attempts, 60/120 s backoff — worst case ~15 min on a dead
+tunnel): the parent re-execs this file with ``DDL25_BENCH_CHILD=1``,
+forwards the child's stderr, and prints the first JSON line that carries
+no ``error``.  Only after exhausting attempts does it emit the last error
+line.  CPU runs (``--cpu`` / ``--force-cpu-devices``) skip the wrapper.
 """
 
 from __future__ import annotations
@@ -47,6 +63,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import threading
 
 import jax
@@ -71,6 +88,114 @@ def probe_devices(timeout_s: float):
     return None, out.get("error", f"device init timed out after {timeout_s:.0f}s")
 
 
+def run_with_retries(argv, attempts: int, child_timeout_s: float) -> None:
+    """Re-exec the bench in fresh subprocesses until one prints a JSON
+    line without an ``error`` field.  Fresh processes because a failed
+    jax TPU backend init is sticky: once ``jax.devices()`` has raised,
+    every later call in the same interpreter raises immediately, so
+    in-process retry can never recover from a transient tunnel outage."""
+    import subprocess
+    import time
+
+    backoff = (60.0, 120.0)
+    last: dict = {}
+    for i in range(attempts):
+        if i:
+            delay = backoff[min(i - 1, len(backoff) - 1)]
+            print(f"bench attempt {i} failed; retrying in {delay:.0f}s "
+                  f"({attempts - i} attempts left)", file=sys.stderr)
+            time.sleep(delay)
+        env = dict(os.environ, DDL25_BENCH_CHILD="1")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), *argv],
+                env=env, capture_output=True, text=True,
+                timeout=child_timeout_s,
+            )
+        except subprocess.TimeoutExpired as e:
+            # probe passed but the run wedged (tunnel died mid-bench):
+            # kill and retry — a hang must not take the driver with it
+            sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
+                             if isinstance(e.stderr, bytes)
+                             else (e.stderr or ""))
+            last = {
+                "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
+                "value": 0.0, "unit": "samples/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"attempt {i + 1}: bench subprocess exceeded "
+                         f"{child_timeout_s:.0f}s and was killed",
+            }
+            continue
+        sys.stderr.write(r.stderr)
+        parsed = None
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                candidate = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            # only dict lines are bench records; a stray printable (bare
+            # number, quoted string) must not crash the retry driver
+            if isinstance(candidate, dict):
+                parsed = candidate
+                break
+        if parsed is not None and "error" not in parsed:
+            print(json.dumps(parsed))
+            return
+        last = parsed or {
+            "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
+            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
+            "error": f"attempt {i + 1}: bench subprocess exited "
+                     f"rc={r.returncode} with no JSON line",
+        }
+    last.setdefault("error", "unknown")
+    last["error"] = f"exhausted {attempts} attempts; last: {last['error']}"
+    print(json.dumps(last))
+
+
+def fedavg_secondary(n_rounds: int = 10) -> dict:
+    """Timed FedAvg round on the tutorial_1a workload — the second metric
+    BASELINE.json names (reference wall-time segmentation:
+    ``lab/tutorial_1a/hfl_complete.py:294,373``).  N=10 C=0.1 B=100 E=1
+    lr=0.01 seed=10, the solved-homework golden config
+    (``lab/series01.ipynb`` cell 20).  One warmup round compiles the
+    vmapped client program; the timed window is ``n_rounds`` full server
+    rounds (host-side client sampling + device-side local epochs +
+    weighted aggregation), reported as ms/round.
+
+    ``DDL25_BENCH_NTRAIN`` shrinks the MNIST split for CPU smoke runs
+    (the single-core XLA CPU backend takes minutes on the full 60k; the
+    TPU headline always uses the full split).  Any failure here must not
+    cost the already-measured primary metric: the caller degrades this
+    entry to an error note instead of letting the exception escape (and
+    burn the retry wrapper's attempts)."""
+    import time
+
+    from ddl25spring_tpu.data.mnist import load_mnist
+    from ddl25spring_tpu.fl import FedAvgServer
+
+    n_train = int(os.environ.get("DDL25_BENCH_NTRAIN", "0")) or 60_000
+    server = FedAvgServer(
+        nr_clients=10, client_fraction=0.1, batch_size=100,
+        nr_local_epochs=1, lr=0.01, seed=10,
+        data=load_mnist(n_train=n_train),
+    )
+    server.round(0)  # compile
+    jax.block_until_ready(jax.tree.leaves(server.params))
+    t0 = time.perf_counter()
+    for r in range(1, n_rounds + 1):
+        server.round(r)
+    jax.block_until_ready(jax.tree.leaves(server.params))
+    ms = (time.perf_counter() - t0) / n_rounds * 1e3
+    return {
+        "metric": "fedavg_round_ms",
+        "value": round(ms, 2),
+        "unit": "ms/round",
+        "n_train": n_train,
+        "note": "tutorial_1a FedAvg N=10 C=0.1 B=100 E=1; one vmapped "
+                "server round incl. host-side sampling",
+    }
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu", action="store_true",
@@ -87,7 +212,23 @@ def main(argv=None) -> None:
                          "mode (0 = auto: largest divisor of "
                          "batches_per_epoch <= 16)")
     ap.add_argument("--probe-timeout", type=float, default=240.0)
+    ap.add_argument("--attempts", type=int, default=3,
+                    help="fresh-subprocess retries for the accelerator "
+                         "path (the TPU tunnel can flake; backend-init "
+                         "failure is sticky in-process)")
+    ap.add_argument("--child-timeout", type=float, default=2400.0,
+                    help="overall wall-clock bound per bench subprocess")
+    ap.add_argument("--no-fedavg", action="store_true",
+                    help="skip the FedAvg round-time secondary metric")
     args = ap.parse_args(argv)
+
+    on_cpu = args.cpu or args.force_cpu_devices
+    if not on_cpu and os.environ.get("DDL25_BENCH_CHILD") != "1":
+        run_with_retries(
+            argv if argv is not None else sys.argv[1:],
+            args.attempts, args.child_timeout,
+        )
+        return
 
     if args.force_cpu_devices:
         from ddl25spring_tpu.utils.platform import force_cpu_devices
@@ -218,6 +359,21 @@ def main(argv=None) -> None:
         rates.append(4.0 / (time.perf_counter() - t0))
     h2d_mib_s = sorted(rates)[1]
 
+    # --- secondary 3: FedAvg round time (BASELINE.json's second metric) ----
+    # guarded: a FedAvg-side failure must degrade to an error note, not
+    # discard the already-measured primary metric (and trigger retries)
+    if args.no_fedavg:
+        fedavg_line = []
+    else:
+        try:
+            fedavg_line = [fedavg_secondary()]
+        except Exception as e:  # noqa: BLE001 — keep the primary metric
+            fedavg_line = [{
+                "metric": "fedavg_round_ms", "value": None,
+                "unit": "ms/round",
+                "note": f"failed: {type(e).__name__}: {e}",
+            }]
+
     flops_step = compiled_flops(step, params, opt_state, feed.fixed)
     achieved_tf, frac = mfu(flops_step, dt_per_step, n_chips, meta["device"])
     peak = chip_peak_flops(meta["device"])
@@ -263,7 +419,7 @@ def main(argv=None) -> None:
                 "value": round(sps_chip_fixed, 1),
                 "unit": "samples/sec/chip",
             },
-        ],
+        ] + fedavg_line,
     ))
 
     feed.close()

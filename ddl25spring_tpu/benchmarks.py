@@ -22,11 +22,7 @@ import numpy as np
 import optax
 
 from ddl25spring_tpu.data.native_loader import normalize_on_device
-from ddl25spring_tpu.models.resnet import (
-    ResNet18,
-    ResNet18Stage0,
-    ResNet18Stage1,
-)
+from ddl25spring_tpu.models.resnet import ResNet18, make_resnet_stages
 from ddl25spring_tpu.ops.losses import cross_entropy_logits
 from ddl25spring_tpu.parallel.dp import make_dp_train_step
 from ddl25spring_tpu.parallel.het_pipeline import make_het_pipeline_train_step
@@ -46,15 +42,17 @@ def build_resnet_step(
 ):
     """Build the north-star train step on ``devices[: dp * S]``.
 
-    ``S == 2`` -> the 2-stage heterogeneous pipeline x DP (``layout
-    "dppp"``); ``S == 1`` -> pure DP.  Returns ``(step, params, opt_state,
-    meta)`` where ``step(params, opt_state, (x_u8, y))`` is jitted and
-    ``meta`` carries layout/topology strings and chip count for reporting.
+    ``S >= 2`` -> the S-stage heterogeneous pipeline x DP (``layout
+    "dppp"``; S up to 4, covering the reference's 2-pipeline x 3-stage
+    flagship topology, ``lab/s01_b2_dp_pp.py:22-29``); ``S == 1`` -> pure
+    DP.  Returns ``(step, params, opt_state, meta)`` where
+    ``step(params, opt_state, (x_u8, y))`` is jitted and ``meta`` carries
+    layout/topology strings and chip count for reporting.
     """
-    if S not in (1, 2):
-        raise ValueError(f"resnet pipeline supports S in (1, 2), got {S}")
+    if S not in (1, 2, 3, 4):
+        raise ValueError(f"resnet pipeline supports S in (1, 2, 3, 4), got {S}")
     n_used = dp * S
-    M = num_microbatches if S == 2 else 1
+    M = num_microbatches if S >= 2 else 1
     if batch % (dp * M):
         raise ValueError(f"batch {batch} not divisible by dp*M = {dp * M}")
     if dtype is None:
@@ -62,23 +60,28 @@ def build_resnet_step(
     tx = optax.sgd(lr, momentum=0.9)
     x8 = jnp.zeros((8, 32, 32, 3), jnp.float32)
 
-    if S == 2:
+    if S >= 2:
         mesh = (
             make_mesh(devices[:n_used], data=dp, stage=S)
             if dp > 1
-            else make_mesh(devices[:2], stage=2)
+            else make_mesh(devices[:S], stage=S)
         )
-        s0, s1 = ResNet18Stage0(dtype=dtype), ResNet18Stage1(dtype=dtype)
-        p0 = s0.init(jax.random.PRNGKey(0), x8)["params"]
-        mid = s0.apply({"params": p0}, x8)
-        p1 = s1.init(jax.random.PRNGKey(1), mid)["params"]
-        params = (p0, p1)
+        stages = make_resnet_stages(S, dtype=dtype)
+        params, shapes, h = [], [], x8
+        for i, sm in enumerate(stages):
+            p = sm.init(jax.random.PRNGKey(i), h)["params"]
+            h = sm.apply({"params": p}, h)
+            params.append(p)
+            shapes.append(h.shape)
+        params = tuple(params)
         mb = batch // M // dp
         inner = make_het_pipeline_train_step(
-            [lambda p, h: s0.apply({"params": p}, h),
-             lambda p, h: s1.apply({"params": p}, h)],
+            [
+                (lambda sm: lambda p, h: sm.apply({"params": p}, h))(sm)
+                for sm in stages
+            ],
             lambda logits, b: cross_entropy_logits(logits, b["y"]),
-            (mb, 32, 32, 3), [(mb,) + mid.shape[1:], (mb, 10)],
+            (mb, 32, 32, 3), [(mb,) + s[1:] for s in shapes],
             tx, mesh, M, data_axis="data" if dp > 1 else None,
             compute_dtype=dtype,
         )

@@ -114,38 +114,83 @@ class ResNet18(nn.Module):
         return y
 
 
-class ResNet18Stage0(nn.Module):
-    """Pipeline stage 0: stem + ``block_plan[:STAGE_CUT]``.
+class ResNet18Stage(nn.Module):
+    """One pipeline stage of the CIFAR ResNet-18: ``block_plan[lo:hi]``,
+    with the stem prepended when ``first`` and pool+classifier appended
+    when ``last`` — the S-generic form of :class:`ResNet18Stage0` /
+    :class:`ResNet18Stage1`, so the benchmark topology is not capped at
+    two stages (the reference's flagship is 2 pipelines x THREE stages,
+    ``lab/s01_b2_dp_pp.py:22-29``).  GroupNorm (stateless) so the
+    pipeline step carries no mutable batch statistics."""
 
-    Output boundary: ``[B, 16, 16, 2*width]`` for 32x32 inputs — the single
-    activation shape crossing the stage cut in the 2-stage DP+PP benchmark
-    topology (BASELINE.json config "2-stage pipeline x 2-way DP").  Uses
-    GroupNorm (stateless) so the pipeline step carries no mutable batch
-    statistics across the scanned schedule.
-    """
-
-    width: int = 64
-    dtype: Any = jnp.float32
-
-    @nn.compact
-    def __call__(self, x):
-        y = _stem(x, self.width, "group", self.dtype, False)
-        for filters, stride in block_plan(self.width)[:STAGE_CUT]:
-            y = ResNetBlock(filters, strides=stride, norm="group", dtype=self.dtype)(y)
-        return y
-
-
-class ResNet18Stage1(nn.Module):
-    """Pipeline stage 1: ``block_plan[STAGE_CUT:]`` + pool + classifier."""
-
+    lo: int
+    hi: int
+    first: bool = False
+    last: bool = False
     num_classes: int = 10
     width: int = 64
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        y = x
-        for filters, stride in block_plan(self.width)[STAGE_CUT:]:
-            y = ResNetBlock(filters, strides=stride, norm="group", dtype=self.dtype)(y)
-        y = jnp.mean(y, axis=(1, 2))
-        return nn.Dense(self.num_classes, dtype=jnp.float32)(y)
+        y = _stem(x, self.width, "group", self.dtype, False) if self.first else x
+        for filters, stride in block_plan(self.width)[self.lo : self.hi]:
+            y = ResNetBlock(
+                filters, strides=stride, norm="group", dtype=self.dtype
+            )(y)
+        if self.last:
+            y = jnp.mean(y, axis=(1, 2))
+            y = nn.Dense(self.num_classes, dtype=jnp.float32)(y)
+        return y
+
+
+def resnet_stage_cuts(num_stages: int) -> list[int]:
+    """Block-plan cut points for S pipeline stages.  Chosen for FLOPs
+    balance: each block pair costs roughly the same (spatial halves as
+    channels double), the stem rides stage 0 and the (cheap) head stage
+    S-1."""
+    cuts = {1: [], 2: [STAGE_CUT], 3: [3, 6], 4: [2, 4, 6]}
+    if num_stages not in cuts:
+        raise ValueError(
+            f"resnet pipeline supports S in (1, 2, 3, 4), got {num_stages}"
+        )
+    return cuts[num_stages]
+
+
+def make_resnet_stages(
+    num_stages: int,
+    num_classes: int = 10,
+    width: int = 64,
+    dtype: Any = jnp.float32,
+) -> list[ResNet18Stage]:
+    """The S stage modules of the benchmark ResNet-18 (S in 1..4).
+    ``compose(stages)`` applied in order equals the monolithic
+    ``ResNet18(norm="group")`` architecture."""
+    cuts = [0] + resnet_stage_cuts(num_stages) + [len(block_plan(width))]
+    return [
+        ResNet18Stage(
+            lo=cuts[i], hi=cuts[i + 1],
+            first=i == 0, last=i == num_stages - 1,
+            num_classes=num_classes, width=width, dtype=dtype,
+        )
+        for i in range(num_stages)
+    ]
+
+
+def ResNet18Stage0(width: int = 64, dtype: Any = jnp.float32) -> ResNet18Stage:
+    """Pipeline stage 0 of the 2-stage split: stem +
+    ``block_plan[:STAGE_CUT]`` (output boundary ``[B, 16, 16, 2*width]``
+    for 32x32 inputs — BASELINE.json's "2-stage pipeline x 2-way DP").
+    Thin factory over :func:`make_resnet_stages` so the 2-stage and
+    S-generic splits share one implementation."""
+    return make_resnet_stages(2, width=width, dtype=dtype)[0]
+
+
+def ResNet18Stage1(
+    num_classes: int = 10, width: int = 64, dtype: Any = jnp.float32
+) -> ResNet18Stage:
+    """Pipeline stage 1 of the 2-stage split: ``block_plan[STAGE_CUT:]``
+    + pool + classifier (factory over :func:`make_resnet_stages`)."""
+    return make_resnet_stages(
+        2, num_classes=num_classes, width=width, dtype=dtype
+    )[1]

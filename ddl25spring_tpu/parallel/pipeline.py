@@ -53,6 +53,7 @@ def staged_param_specs(
     ep_axis: str | None = None,
     tp_axis: str | None = None,
     chunked: bool = False,
+    n_experts: int = 0,
 ) -> Params:
     """``ep_axis``: additionally shard the switch-MoE expert stacks over
     that axis (dim 2 of the ``[S, L/S, E, ...]`` stacks) — expert
@@ -66,7 +67,16 @@ def staged_param_specs(
     :mod:`ddl25spring_tpu.parallel.tp` uses, lifted onto staged blocks
     for the 3-D DP x PP x TP composition.  ``chunked=True`` targets the
     interleaved ``[S, V, Lc, d, d]`` stacks (one more leading dim before
-    the matmul dims)."""
+    the matmul dims).
+
+    ``n_experts > 0`` with ``tp_axis`` selects the switch-MoE block
+    schema: attention matmuls column/row-split as above, and the expert
+    stacks ``[S,(V,)Lc, E, ...]`` sharded on their EXPERT dim over the
+    tp axis (the :func:`~ddl25spring_tpu.parallel.tp.make_tp_moe_fn`
+    layout lifted onto staged stacks); the router stays replicated
+    across tp like the norms.  Without it, TP specs assume the dense
+    block key set — pass the config's expert count so MoE params don't
+    fail with an opaque tree-map KeyError."""
     if ep_axis is not None and tp_axis is not None:
         raise NotImplementedError("ep_axis and tp_axis are exclusive")
     if ep_axis is not None and chunked:
@@ -92,11 +102,25 @@ def staged_param_specs(
         from ddl25spring_tpu.parallel.tp import _COL, _ROW
 
         pad = (None,) * (2 if chunked else 1)  # [S,(V,)Lc] leading dims
-        blocks = {
-            "ln1": P(stage_axis), "ln2": P(stage_axis),
-            **{k: P(stage_axis, *pad, None, tp_axis) for k in _COL},
-            **{k: P(stage_axis, *pad, tp_axis, None) for k in _ROW},
-        }
+        if n_experts > 0:
+            blocks = {
+                "ln1": P(stage_axis), "ln2": P(stage_axis),
+                **{k: P(stage_axis, *pad, None, tp_axis)
+                   for k in ("wq", "wk", "wv")},
+                "wo": P(stage_axis, *pad, tp_axis, None),
+                "moe": {
+                    "router": P(stage_axis),
+                    "w_gate": P(stage_axis, *pad, tp_axis),
+                    "w_up": P(stage_axis, *pad, tp_axis),
+                    "w_down": P(stage_axis, *pad, tp_axis),
+                },
+            }
+        else:
+            blocks = {
+                "ln1": P(stage_axis), "ln2": P(stage_axis),
+                **{k: P(stage_axis, *pad, None, tp_axis) for k in _COL},
+                **{k: P(stage_axis, *pad, tp_axis, None) for k in _ROW},
+            }
     return {
         "embed": P(),
         "blocks": blocks,
@@ -107,16 +131,65 @@ def staged_param_specs(
 
 def _check_tp(cfg: LlamaConfig, mesh: Mesh, tp_axis: str) -> None:
     """Shared TP preconditions for the pipeline schedules."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "switch-MoE under pipeline TP is not wired; use EP "
-            "(ep_axis) or TP-only (parallel.tp.make_tp_moe_fn)"
-        )
     t = mesh.shape[tp_axis]
     if cfg.num_heads % t:
         raise ValueError(
             f"num_heads ({cfg.num_heads}) not divisible by {tp_axis}={t}"
         )
+    if cfg.n_experts > 0 and cfg.n_experts % t:
+        raise ValueError(
+            f"n_experts ({cfg.n_experts}) not divisible by {tp_axis}={t}"
+        )
+
+
+def _ep_moe_fn(
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    ep_axis: str,
+    data_axis: str | None,
+    vary_axes: tuple[str, ...],
+):
+    """EP validation + the ``ep_moe_local`` closure shared by the GPipe
+    and 1F1B schedules.  They differ only in ``vary_axes``: the GPipe path
+    keeps blocks data-invariant so the router is pcast inside
+    ``ep_moe_local``; the 1F1B path pcasts the router itself (with the
+    other invariant block leaves) and passes ``()``."""
+    if cfg.n_experts <= 0:
+        raise ValueError("ep_axis given but cfg.n_experts == 0")
+    if ep_axis != data_axis:
+        # tokens shard over data only; an EP axis the tokens are
+        # replicated over would all_to_all duplicate work
+        raise ValueError(
+            f"ep_axis {ep_axis!r} must be the data axis {data_axis!r}"
+        )
+    ep_n = mesh.shape[ep_axis]
+    if cfg.n_experts % ep_n:
+        raise ValueError(
+            f"{cfg.n_experts} experts not divisible by {ep_axis}={ep_n}"
+        )
+    from ddl25spring_tpu.parallel.ep import ep_moe_local
+
+    def moe_fn(mp, flat):
+        return ep_moe_local(
+            mp, flat, axis=ep_axis, ep=ep_n,
+            capacity_factor=cfg.capacity_factor,
+            vary_axes=vary_axes, top_k=cfg.moe_top_k,
+        )
+
+    return moe_fn
+
+
+def _tp_moe_fn(cfg: LlamaConfig, tp_axis: str):
+    """The expert-sharded MoE FFN the pipeline schedules inject under
+    ``tp_axis`` when ``cfg.n_experts > 0``: global routing replicated
+    across tp (tokens already are), each member applying its ``E/t``
+    expert slice, the block's row-parallel psum completing the combine —
+    :func:`~ddl25spring_tpu.parallel.tp.make_tp_moe_fn` riding the staged
+    stacks, so pipeline-TP-MoE keeps exact drop parity with the serial
+    ``moe_ffn``."""
+    from ddl25spring_tpu.parallel.tp import make_tp_moe_fn
+
+    return make_tp_moe_fn(tp_axis, cfg.capacity_factor, cfg.moe_top_k)
 
 
 def make_pipeline_loss(
@@ -199,31 +272,12 @@ def make_pipeline_loss(
         _check_tp(cfg, mesh, tp_axis)
 
     moe_fn = None
+    if tp_axis is not None and cfg.n_experts > 0:
+        moe_fn = _tp_moe_fn(cfg, tp_axis)
     if ep_axis is not None:
-        if cfg.n_experts <= 0:
-            raise ValueError("ep_axis given but cfg.n_experts == 0")
-        if ep_axis != data_axis:
-            # tokens shard over data only; an EP axis the tokens are
-            # replicated over would all_to_all duplicate work
-            raise ValueError(
-                f"ep_axis {ep_axis!r} must be the data axis {data_axis!r}"
-            )
-        ep_n = mesh.shape[ep_axis]
-        if cfg.n_experts % ep_n:
-            raise ValueError(
-                f"{cfg.n_experts} experts not divisible by "
-                f"{ep_axis}={ep_n}"
-            )
-        from ddl25spring_tpu.parallel.ep import ep_moe_local
-
-        def moe_fn(mp, flat):
-            # router is stage-varying but data-invariant inside this
-            # shard_map; ep_moe_local pcasts it over the EP(=data) axis
-            return ep_moe_local(
-                mp, flat, axis=ep_axis, ep=ep_n,
-                capacity_factor=cfg.capacity_factor,
-                vary_axes=(ep_axis,), top_k=cfg.moe_top_k,
-            )
+        # router is stage-varying but data-invariant inside this
+        # shard_map; ep_moe_local pcasts it over the EP(=data) axis
+        moe_fn = _ep_moe_fn(cfg, mesh, ep_axis, data_axis, (ep_axis,))
 
     tok_spec = P(None, data_axis)  # [M, mb, L]: shard microbatch dim over data
 
@@ -231,7 +285,10 @@ def make_pipeline_loss(
         shard_map,
         mesh=mesh,
         in_specs=(
-            staged_param_specs(stage_axis, ep_axis, tp_axis, chunked=V > 1),
+            staged_param_specs(
+                stage_axis, ep_axis, tp_axis, chunked=V > 1,
+                n_experts=cfg.n_experts,
+            ),
             tok_spec,
         ),
         out_specs=P(),
@@ -289,7 +346,8 @@ def make_pipeline_loss(
             x_in = jnp.where(inject, x_first, incoming)
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
-                    chunk, x_in, cfg, with_aux=True, moe_fn=moe_fn
+                    chunk, x_in, cfg, with_aux=True, moe_fn=moe_fn,
+                    tp_axis=tp_axis,
                 )
                 # aux from drain-tick garbage is masked (the weight also
                 # zeroes its cotangent)
@@ -412,6 +470,8 @@ def make_1f1b_value_and_grad(
     data_axis: str | None = None,
     stash: str = "input",
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
+    num_chunks: int = 1,
 ):
     """1F1B: the memory-bounded pipeline schedule, hand-rolled backward.
 
@@ -469,20 +529,85 @@ def make_1f1b_value_and_grad(
       ``(2S-1) x |stage residuals|`` memory.  The ring is initialized from
       a valid example trace (not zeros) so drain-tick replays stay finite
       before the ``w = 0`` mask zeroes them.
+
+    ``ep_axis`` (must be the data axis): EP x DP x PP under 1F1B — the
+    expert stacks shard over the data axis, each tick's MoE dispatch
+    moving capacity buckets between data rows via ``all_to_all``
+    (:func:`~ddl25spring_tpu.parallel.ep.ep_moe_local`, same design as
+    the GPipe path).  Collectives must sit in UNIFORM control flow, so
+    with ``ep_axis`` the forward slot runs the stage body on every tick
+    and masks the output (``jnp.where``) instead of ``lax.cond``-skipping
+    it — the standard restructure; drain ticks then pay one dead stage
+    forward, the price of composing the a2a with the tick schedule.
+    Expert-slice grads are per-shard (each data row owns ``E/n`` experts
+    assembled from every row's tokens by the a2a transpose), so they take
+    ``1/n`` normalization instead of the data ``pmean``.
+
+    ``num_chunks > 1`` is the INTERLEAVED 1F1B — the production Megatron
+    schedule: each device holds ``V`` non-contiguous chunks
+    (``split_blocks_interleaved``) and BOTH streams ride the Megatron slot
+    grouping.  Forward slot ``k = t - s`` maps to ``(chunk v, microbatch
+    m)`` exactly as in :func:`make_interleaved_pipeline_loss`; the
+    backward stream is its mirror — slot ``k_b = t - (VS-1) - (S-1-s)``
+    maps through the SAME grouping onto REVERSED chunks (``v_b = V-1-v'``)
+    so cotangents walk the reversed virtual pipeline one device per tick,
+    the wrap ``0 -> S-1`` of the reverse ppermute carrying the
+    chunk-``v`` -> ``v-1`` hand-off exactly one tick before use.  The
+    delay ``VS - 1`` is the tightest that keeps every backward after its
+    forward (equality holds at ``(V-1, S-1)``: same-tick fwd+bwd, as at
+    ``V = 1``).  The input ring grows to ``2VS - 1`` slots (max live
+    range ``2VS - 2`` ticks at ``(v=0, s=0)``), still M-invariant —
+    O(S·V) activations versus the scan-transpose interleaved schedule's
+    O(M·V) — and the schedule length is ``MV + VS + S - 2`` chunk-ticks
+    versus plain 1F1B's ``V(M + 2S - 2)``: the ``(V-1)(S-2)``-chunk-tick
+    bubble win of interleaving composed with the bounded memory of 1F1B.
+    ``V = 1`` reduces every formula to the plain schedule above (this is
+    the single implementation of both).  ``stash`` must be ``"input"``
+    and ``ep_axis`` ``None`` under ``num_chunks > 1``.
     """
     if stash not in ("input", "residuals"):
         raise ValueError(f"stash must be 'input' or 'residuals', got {stash!r}")
     S = mesh.shape[stage_axis]
     M = num_microbatches
+    V = num_chunks
     dtype = jnp.dtype(cfg.dtype)
-    K = 2 * S - 1  # ring slots; slot K is scratch for inactive ticks
+    K = 2 * V * S - 1  # ring slots; slot K is scratch for inactive ticks
+    DELTA = V * S - 1  # backward-stream delay (== S-1 at V == 1)
+    if V > 1:
+        if stash != "input":
+            raise NotImplementedError(
+                "interleaved 1F1B ships the input-stash (remat) backward; "
+                "residual rings are not wired for chunked stacks"
+            )
+        if ep_axis is not None:
+            raise NotImplementedError(
+                "EP expert sharding is not wired for the interleaved "
+                "(chunked) block layout"
+            )
+        if M % S:
+            raise ValueError(
+                f"interleaved schedule needs microbatches ({M}) divisible "
+                f"by stages ({S})"
+            )
     if tp_axis is not None:
         _check_tp(cfg, mesh, tp_axis)
 
     tok_spec = P(None, data_axis)
     # one spec tree serves both sides: param grads come back in the same
     # layout the params go in
-    param_specs = staged_param_specs(stage_axis, tp_axis=tp_axis)
+    param_specs = staged_param_specs(
+        stage_axis, ep_axis=ep_axis, tp_axis=tp_axis, chunked=V > 1,
+        n_experts=cfg.n_experts,
+    )
+    moe_fn = (
+        _tp_moe_fn(cfg, tp_axis)
+        if tp_axis is not None and cfg.n_experts > 0 else None
+    )
+    if ep_axis is not None:
+        # the router is pcast over data with the other invariant block
+        # leaves below, so vary_axes is empty here (unlike the GPipe
+        # path, which keeps blocks invariant over data)
+        moe_fn = _ep_moe_fn(cfg, mesh, ep_axis, data_axis, ())
 
     @partial(
         shard_map,
@@ -507,88 +632,177 @@ def make_1f1b_value_and_grad(
         )
         # blocks are varying over stage (and tp, when sharded) already;
         # only the data axis needs the explicit pcast
-        vblocks = lax.pcast(
-            local_blocks, (data_axis,), to="varying"
-        ) if data_axis else local_blocks
+        if data_axis and ep_axis:
+            # the expert stacks arrive SHARDED (hence varying) over the
+            # data axis; pcast only the data-invariant leaves
+            vblocks = {
+                k: lax.pcast(v, (data_axis,), to="varying")
+                for k, v in local_blocks.items() if k != "moe"
+            }
+            vblocks["moe"] = dict(
+                local_blocks["moe"],
+                router=lax.pcast(
+                    local_blocks["moe"]["router"], (data_axis,), to="varying"
+                ),
+            )
+        elif data_axis:
+            vblocks = lax.pcast(local_blocks, (data_axis,), to="varying")
+        else:
+            vblocks = local_blocks
 
         is_last = s == S - 1
 
-        def local_fwd_loss(blocks, hd, x_in, tok, embed_in=True):
-            """This stage's slice of the model, as one differentiable fn:
-            stage 0 prepends embed (``embed_in=True``), the last stage
-            appends unembed+loss; MoE stages add their layers' weighted aux
-            loss.  The residual-stash path passes ``embed_in=False`` and
-            handles the embed outside — see the closure_convert note there."""
+        def local_fwd_loss(
+            blocks, hd, x_in, tok, inject=None, finish=None, embed_in=True
+        ):
+            """This (virtual) stage's slice of the model, as one
+            differentiable fn: the injecting slot prepends embed
+            (``embed_in=True``), the finishing slot appends unembed+loss;
+            MoE stages add their layers' weighted aux loss.  ``inject`` /
+            ``finish`` default to the plain-1F1B flags (first / last
+            device); the interleaved schedule passes its slot-dependent
+            flags.  The residual-stash path passes ``embed_in=False`` and
+            handles the embed outside — see the closure_convert note
+            there."""
+            inject = (s == 0) if inject is None else inject
+            finish = is_last if finish is None else finish
             if embed_in:
                 x_in = lax.cond(
-                    s == 0,
+                    inject,
                     lambda x: llama.embed(hd, tok, cfg),
                     lambda x: x,
                     x_in,
                 )
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
-                    blocks, x_in, cfg, with_aux=True
+                    blocks, x_in, cfg, with_aux=True, moe_fn=moe_fn,
+                    tp_axis=tp_axis,
                 )
                 aux_term = jnp.float32(cfg.moe_aux_weight) * aux
             else:
                 x_out = llama.apply_blocks(blocks, x_in, cfg, tp_axis=tp_axis)
                 aux_term = jnp.float32(0.0)
             loss = lax.cond(
-                is_last,
+                finish,
                 lambda x: causal_lm_loss(llama.unembed(hd, x, cfg), tok),
                 lambda x: lax.pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
             )
             return x_out, loss + aux_term
 
+        def chunk_slice(tree, v):
+            """Chunk ``v``'s blocks from the local ``[V, Lc, ...]`` stacks
+            (identity at V == 1, where the stacks are ``[Lc, ...]``)."""
+            if V == 1:
+                return tree
+            return jax.tree.map(
+                lambda x: lax.dynamic_index_in_dim(x, v, 0, keepdims=False),
+                tree,
+            )
+
+        def fwd_slot(k):
+            """Megatron slot map (see make_interleaved_pipeline_loss):
+            forward slot ``k`` -> (chunk ``v``, microbatch ``m``, and the
+            inject/finish/skip flags for this device)."""
+            if V == 1:
+                m = jnp.clip(k, 0, M - 1)
+                return 0, m, s == 0, is_last
+            g, j = jnp.divmod(jnp.clip(k, 0, M * V - 1), V * S)
+            v, r = jnp.divmod(j, S)
+            m = g * S + r
+            return v, m, jnp.logical_and(s == 0, v == 0), jnp.logical_and(
+                is_last, v == V - 1
+            )
+
+        def bwd_slot(k_b):
+            """The mirrored backward stream: slot ``k_b`` maps through the
+            SAME grouping onto REVERSED chunks, plus the ring index of the
+            matching forward slot (where its input was stashed)."""
+            if V == 1:
+                m = jnp.clip(k_b, 0, M - 1)
+                return 0, m, jnp.clip(k_b, 0, M - 1), s == 0, is_last
+            g, j = jnp.divmod(jnp.clip(k_b, 0, M * V - 1), V * S)
+            v_rev, r = jnp.divmod(j, S)
+            v = V - 1 - v_rev
+            m = g * S + r
+            k_fwd = g * V * S + v * S + r  # forward slot of (v, m)
+            return v, m, k_fwd, jnp.logical_and(s == 0, v == 0), (
+                jnp.logical_and(is_last, v == V - 1)
+            )
+
         def tick(carry, t):
             fwd_in, cot_in, ring, gblocks, ghead, loss_sum = carry
 
-            # ---- forward slot: GPipe timing (mb f at tick f + s) ----------
+            # ---- forward slot: GPipe timing (slot k = t - s) --------------
             f_idx = t - s
-            fwd_active = jnp.logical_and(f_idx >= 0, f_idx < M)
-            tok_f = tokens_mb[jnp.clip(f_idx, 0, M - 1)]
+            fwd_active = jnp.logical_and(f_idx >= 0, f_idx < M * V)
+            v_f, m_f, inject_f, finish_f = fwd_slot(f_idx)
+            tok_f = tokens_mb[m_f]
             x_first = llama.embed(head, tok_f, cfg)
-            x_in = jnp.where(s == 0, x_first, fwd_in)
+            x_in = jnp.where(inject_f, x_first, fwd_in)
             # stash the stage INPUT (all the backward needs — the stage body
             # is recomputed); inactive ticks write the scratch slot
             ring = lax.dynamic_update_index_in_dim(
                 ring, x_in, jnp.where(fwd_active, f_idx % K, K), axis=0
             )
-            # the last stage's forward is fully redone by its same-tick
-            # backward below; skip the dead compute
-            x_out = lax.cond(
-                jnp.logical_and(fwd_active, jnp.logical_not(is_last)),
-                lambda x: llama.apply_blocks(
-                    local_blocks, x, cfg, tp_axis=tp_axis
-                ),
-                lambda x: x,
-                x_in,
-            )
+            # a finishing slot's forward is fully redone by its same-tick
+            # backward below; skip the dead compute.  Under EP the stage
+            # body carries an all_to_all, which must execute in UNIFORM
+            # control flow — run it unconditionally and mask the output
+            # instead (drain ticks pay one dead stage forward)
+            run_fwd = jnp.logical_and(fwd_active, jnp.logical_not(finish_f))
+            chunk_f = chunk_slice(local_blocks, v_f)
+            if ep_axis is not None:
+                x_body = llama.apply_blocks(
+                    vblocks, x_in, cfg, tp_axis=tp_axis, moe_fn=moe_fn
+                )
+                x_out = jnp.where(run_fwd, x_body, x_in)
+            else:
+                x_out = lax.cond(
+                    run_fwd,
+                    lambda x: llama.apply_blocks(
+                        chunk_f, x, cfg, tp_axis=tp_axis, moe_fn=moe_fn
+                    ),
+                    lambda x: x,
+                    x_in,
+                )
 
-            # ---- backward slot: mb b finishes S-1+b at the last stage and
-            # walks back one stage per tick ---------------------------------
-            b_idx = t - (2 * (S - 1) - s)
-            bwd_active = jnp.logical_and(b_idx >= 0, b_idx < M)
-            x_saved = ring[jnp.clip(jnp.where(bwd_active, b_idx % K, K), 0, K)]
-            tok_b = tokens_mb[jnp.clip(b_idx, 0, M - 1)]
+            # ---- backward slot: the reversed stream at delay VS-1 (mb b
+            # finishes its last chunk at the last device and walks the
+            # reversed virtual pipeline one device per tick) ----------------
+            b_idx = t - DELTA - (S - 1 - s)
+            bwd_active = jnp.logical_and(b_idx >= 0, b_idx < M * V)
+            v_b, m_b, k_fwd_b, inject_b, finish_b = bwd_slot(b_idx)
+            x_saved = ring[
+                jnp.clip(jnp.where(bwd_active, k_fwd_b % K, K), 0, K)
+            ]
+            tok_b = tokens_mb[m_b]
+            vchunk_b = chunk_slice(vblocks, v_b)
 
             (x_out_b, loss_b), pull = jax.vjp(
-                lambda b, h, x: local_fwd_loss(b, h, x, tok_b),
-                vblocks, head, x_saved,
+                lambda b, h, x: local_fwd_loss(
+                    b, h, x, tok_b, inject_b, finish_b
+                ),
+                vchunk_b, head, x_saved,
             )
-            # cotangent seed: downstream cotangent for interior stages, the
-            # scalar loss for the last (its x_out feeds nothing but the
-            # loss).  The loss seed is 1.0 on EVERY stage: non-last dense
-            # stages output the constant 0 (zero pullback), and MoE stages
-            # need their aux term differentiated
-            g_out = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
+            # cotangent seed: downstream cotangent for interior slots, the
+            # scalar loss for the finishing one (its x_out feeds nothing but
+            # the loss).  The loss seed is 1.0 on EVERY slot: non-finishing
+            # dense slots output the constant 0 (zero pullback), and MoE
+            # chunks need their aux term differentiated
+            g_out = jnp.where(finish_b, jnp.zeros_like(cot_in), cot_in)
             g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + 1.0
             db, dh, dx = pull((g_out.astype(x_out_b.dtype), g_loss))
 
             w = jnp.where(bwd_active, jnp.float32(1.0), jnp.float32(0.0))
-            gblocks = jax.tree.map(lambda a, g: a + w * g, gblocks, db)
+            if V == 1:
+                gblocks = jax.tree.map(lambda a, g: a + w * g, gblocks, db)
+            else:
+                # scatter-accumulate into chunk v_b's slice of the
+                # [V, Lc, ...] grad stacks
+                gblocks = jax.tree.map(
+                    lambda a, g: a.at[v_b].add(w * g), gblocks, db
+                )
             ghead = jax.tree.map(lambda a, g: a + w * g, ghead, dh)
             loss_sum = loss_sum + w * loss_b
 
@@ -611,7 +825,8 @@ def make_1f1b_value_and_grad(
             jax.tree.map(lambda x: vzeros(x, jnp.float32), local_blocks),
             jax.tree.map(lambda x: vzeros(x, jnp.float32), head),
         )
-        T = M + 2 * (S - 1)
+        # schedule length: M + 2(S-1) at V == 1; MV + VS + S - 2 interleaved
+        T = M * V + V * S + S - 2
 
         if stash == "residuals":
             # One example trace of the stage vjp: closure_convert hoists
@@ -632,7 +847,7 @@ def make_1f1b_value_and_grad(
             ex_x = vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype)
             ex_tok = tokens_mb[0]
             _, ex_pull = jax.vjp(
-                lambda b, h, x: local_fwd_loss(b, h, x, ex_tok, False),
+                lambda b, h, x: local_fwd_loss(b, h, x, ex_tok, embed_in=False),
                 vblocks, head, ex_x,
             )
             ex_cot = (
@@ -656,7 +871,9 @@ def make_1f1b_value_and_grad(
                 x_first = llama.embed(head, tok_f, cfg)
                 x_in = jnp.where(s == 0, x_first, fwd_in)
                 (x_out, loss_f), pull_f = jax.vjp(
-                    lambda b, h, x: local_fwd_loss(b, h, x, tok_f, False),
+                    lambda b, h, x: local_fwd_loss(
+                        b, h, x, tok_f, embed_in=False
+                    ),
                     vblocks, head, x_in,
                 )
                 # the converted pullback MUST come from this same trace so
@@ -760,19 +977,50 @@ def make_1f1b_value_and_grad(
             # their P(stage) out_spec needs the static invariance)
             t = lax.psum(1, tp_axis)
             loss = lax.pmean(loss, tp_axis)
-            gblocks = {
-                k: jax.tree.map(
-                    (lambda g: lax.pmean(g / t, tp_axis))
-                    if k in ("ln1", "ln2")
-                    else (lambda g: g / t),
-                    v,
-                )
-                for k, v in gblocks.items()
-            }
+
+            def _norm_repl(g):
+                return lax.pmean(g / t, tp_axis)
+
+            def _norm_shard(g):
+                return g / t
+
+            def _norm(k, v):
+                if k == "moe":
+                    # router is replicated across tp like the norms (its
+                    # P(stage) out_spec needs the invariance re-typing);
+                    # the expert stacks are tp-sharded slices like the
+                    # dense matmuls
+                    return {
+                        kk: (_norm_repl if kk == "router" else _norm_shard)(vv)
+                        for kk, vv in v.items()
+                    }
+                return (_norm_repl if k in ("ln1", "ln2") else _norm_shard)(v)
+
+            gblocks = {k: _norm(k, v) for k, v in gblocks.items()}
             ghead = jax.tree.map(lambda g: lax.pmean(g, tp_axis), ghead)
         if data_axis is not None:
             loss = lax.pmean(loss, data_axis)
-            gblocks = jax.tree.map(lambda g: lax.pmean(g, data_axis), gblocks)
+            if ep_axis is not None:
+                # expert slices are per-shard (each data row owns E/n
+                # experts, their grads already assembled from every row's
+                # tokens by the a2a transpose): 1/n normalization, no
+                # collective — a pmean would average DIFFERENT experts.
+                # The replicated router keeps the invariant treatment.
+                n = lax.psum(1, data_axis)
+                gmoe = gblocks["moe"]
+                gblocks = {
+                    k: jax.tree.map(lambda g: lax.pmean(g, data_axis), v)
+                    for k, v in gblocks.items() if k != "moe"
+                }
+                gblocks["moe"] = {
+                    kk: (lax.pmean(vv, data_axis) if kk == "router"
+                         else vv / n)
+                    for kk, vv in gmoe.items()
+                }
+            else:
+                gblocks = jax.tree.map(
+                    lambda g: lax.pmean(g, data_axis), gblocks
+                )
             ghead = jax.tree.map(lambda g: lax.pmean(g, data_axis), ghead)
         grads = {
             "embed": ghead["embed"],
@@ -812,20 +1060,34 @@ def make_pipeline_train_step(
     interleaved schedule with remat backward, parity with
     ``intro_PP_1F1B.py`` generalized to M microbatches),
     ``"1f1b-stash"`` (non-remat 1F1B: pullback residuals ring-stashed,
-    no forward recompute — see :func:`make_1f1b_value_and_grad`), or
+    no forward recompute — see :func:`make_1f1b_value_and_grad`),
     ``"interleaved"`` (virtual-stage chunking with ``num_chunks`` chunks
     per device, bubble reduced ~V× — see
     :func:`make_interleaved_pipeline_loss`; params split by
+    ``split_blocks_interleaved``), or ``"interleaved-1f1b"`` (the
+    production Megatron schedule: interleaved virtual stages WITH the
+    memory-bounded hand-rolled 1F1B backward — O(S·V) ring stash instead
+    of the scan transpose's O(M·V) residuals; params split by
     ``split_blocks_interleaved``).
 
     ``ep_axis``: shard the MoE expert stacks over the data axis too
-    (EP x DP x PP, gpipe schedule only — see :func:`make_pipeline_loss`);
-    pass params through ``shard_staged_params(..., ep_axis=...)``.
+    (EP x DP x PP — see :func:`make_pipeline_loss` for gpipe and
+    :func:`make_1f1b_value_and_grad` for the 1F1B schedules; the
+    interleaved schedule still keeps experts replicated); pass params
+    through ``shard_staged_params(..., ep_axis=...)``.
 
     ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on EVERY
     schedule; pass params through ``shard_staged_params(..., tp_axis=...)``
     (adding ``chunked=True`` for the interleaved 5-d stacks).
     """
+    if num_chunks > 1 and schedule not in ("interleaved", "interleaved-1f1b"):
+        # silently falling back to plain GPipe would train a different
+        # schedule than asked for AND fail later at shard_map spec-rank
+        # mismatch if the params were split with split_blocks_interleaved
+        raise ValueError(
+            f"num_chunks={num_chunks} needs schedule='interleaved' or "
+            f"'interleaved-1f1b' (got {schedule!r})"
+        )
     if schedule == "interleaved":
         if ep_axis is not None:
             raise NotImplementedError(
@@ -836,19 +1098,19 @@ def make_pipeline_train_step(
             tp_axis=tp_axis,
         )
         vag = jax.value_and_grad(loss_fn)
+    elif schedule == "interleaved-1f1b":
+        if num_chunks < 2:
+            raise ValueError("interleaved-1f1b needs num_chunks >= 2")
+        vag = make_1f1b_value_and_grad(
+            cfg, mesh, num_microbatches, stage_axis, data_axis,
+            stash="input", tp_axis=tp_axis, ep_axis=ep_axis,
+            num_chunks=num_chunks,
+        )
     elif schedule in ("1f1b", "1f1b-stash"):
-        if ep_axis is not None:
-            raise NotImplementedError(
-                "EP expert sharding rides the gpipe schedule; the 1F1B "
-                "ticks run the stage body inside lax.cond (skip-dead-"
-                "compute), where the EP all_to_all would be a collective "
-                "in non-uniform control flow — keep experts replicated "
-                "under 1F1B"
-            )
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="residuals" if schedule == "1f1b-stash" else "input",
-            tp_axis=tp_axis,
+            tp_axis=tp_axis, ep_axis=ep_axis,
         )
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
@@ -956,8 +1218,17 @@ def shard_staged_params(
     ``tp_axis``, block matmuls additionally column/row-shard over it
     (DP x PP x TP).  Pass ``chunked=True`` when the params came from
     ``split_blocks_interleaved`` (5-d ``[S, V, Lc, d, d]`` stacks) so the
-    TP specs target the matmul dims, not the extra chunk dim."""
-    specs = staged_param_specs(stage_axis, ep_axis, tp_axis, chunked)
+    TP specs target the matmul dims, not the extra chunk dim.  Switch-MoE
+    params are detected from the tree (the ``moe`` subtree) so the TP
+    branch emits the expert-sharded schema instead of failing on the
+    dense key set."""
+    n_experts = (
+        params["blocks"]["moe"]["router"].shape[-1]
+        if "moe" in params["blocks"] else 0
+    )
+    specs = staged_param_specs(
+        stage_axis, ep_axis, tp_axis, chunked, n_experts=n_experts
+    )
     blocks_spec = specs["blocks"]
     if isinstance(blocks_spec, P):
         blocks = jax.tree.map(

@@ -190,7 +190,7 @@ def ring_flash_attention(
     return o_acc.astype(dtype)
 
 
-def ulysses_attention(q, k, v, axis: str, dtype):
+def ulysses_attention(q, k, v, axis: str, dtype, use_flash: bool = True):
     """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention
     inside ``shard_map``.
 
@@ -200,9 +200,12 @@ def ulysses_attention(q, k, v, axis: str, dtype):
     a head sharding ``[B, n*Ll, H/n, hd]`` — shard ``s`` holds contiguous
     positions ``[s*Ll, (s+1)*Ll)`` (the :func:`make_sp_loss` layout), so the
     index-ordered concat reassembles the true sequence — then full-length
-    causal attention runs locally (Pallas flash on TPU, dense off-TPU where
-    the interpreter cannot run under VMA-checked shard_map), and the inverse
-    ``all_to_all`` restores ``[B, Ll, H, hd]``.
+    causal attention runs locally (Pallas flash on TPU when ``use_flash``,
+    dense otherwise and off-TPU where the interpreter cannot run under
+    VMA-checked shard_map), and the inverse ``all_to_all`` restores
+    ``[B, Ll, H, hd]``.  ``use_flash`` mirrors the ring path's
+    ``cfg.use_flash`` gating so ``--no-flash`` debugging degrades BOTH
+    modes to dense attention.
     """
     n = lax.psum(1, axis)
     H = q.shape[2]
@@ -214,7 +217,7 @@ def ulysses_attention(q, k, v, axis: str, dtype):
     qkv = jnp.stack((q, k, v))  # [3, B, Ll, H, hd]
     qkv = lax.all_to_all(qkv, axis, split_axis=3, concat_axis=2, tiled=True)
     qg, kg, vg = qkv[0], qkv[1], qkv[2]
-    if jax.default_backend() == "tpu":
+    if use_flash and jax.default_backend() == "tpu":
         from ddl25spring_tpu.ops.flash_attention import flash_attention
 
         o = flash_attention(qg, kg, vg)
@@ -270,7 +273,9 @@ def make_sp_loss(
 
         if mode == "ulysses":
             def attn(q, k, v, dtype):
-                return ulysses_attention(q, k, v, seq_axis, dtype)
+                return ulysses_attention(
+                    q, k, v, seq_axis, dtype, use_flash=cfg.use_flash
+                )
         elif cfg.use_flash:
             # flash local step + lse merge: O(Ll·d) per-shard attention
             def attn(q, k, v, dtype):

@@ -39,14 +39,17 @@ def parse_args(argv=None):
     ap.add_argument("--force-cpu-devices", type=int, default=0, metavar="N",
                     help="simulate an N-device mesh on CPU")
     ap.add_argument("--schedule",
-                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved"),
+                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved",
+                             "interleaved-1f1b"),
                     default="gpipe",
                     help="pipeline schedule: gpipe (homework B1 parity), "
                          "1f1b (memory-bounded, remat backward; activation "
                          "stash O(S) not O(M)), 1f1b-stash (non-remat "
                          "1F1B: pullback residuals stashed, no forward "
-                         "recompute), or interleaved (virtual-stage "
-                         "chunking, --chunks per device; bubble ~/V)")
+                         "recompute), interleaved (virtual-stage "
+                         "chunking, --chunks per device; bubble ~/V), or "
+                         "interleaved-1f1b (Megatron production schedule: "
+                         "chunked AND memory-bounded)")
     ap.add_argument("--chunks", type=int, default=2, metavar="V",
                     help="interleaved schedule: layer chunks per device "
                          "(needs microbatches %% stages == 0 and "
@@ -109,7 +112,8 @@ def main(argv=None) -> None:
           f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
-    if args.schedule == "interleaved":
+    chunked = args.schedule.startswith("interleaved")
+    if chunked:
         split = lambda p: llama.split_blocks_interleaved(p, S, args.chunks)
     else:
         split = lambda p: llama.split_blocks_for_stages(p, S)
@@ -120,7 +124,7 @@ def main(argv=None) -> None:
     def build_step(c):
         return make_pipeline_train_step(
             c, tx, mesh, args.microbatches, schedule=args.schedule,
-            num_chunks=args.chunks,
+            num_chunks=args.chunks if chunked else 1,
         )
 
     step = build_step(cfg)

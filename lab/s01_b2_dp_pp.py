@@ -65,12 +65,16 @@ def parse_args(argv=None):
     ap.add_argument("--no-stream", dest="input", action="store_const",
                     const="fixed", help="alias for --input fixed")
     ap.add_argument("--schedule",
-                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved"),
+                    choices=("gpipe", "1f1b", "1f1b-stash", "interleaved",
+                             "interleaved-1f1b"),
                     default="gpipe",
                     help="llama: pipeline schedule (1f1b bounds activation "
                          "memory at O(S) instead of O(M); 1f1b-stash is the "
                          "non-remat variant; interleaved chunks each stage "
-                         "into --chunks virtual stages, bubble ~/V)")
+                         "into --chunks virtual stages, bubble ~/V; "
+                         "interleaved-1f1b composes chunking with the "
+                         "bounded 1F1B backward — the Megatron production "
+                         "schedule)")
     ap.add_argument("--chunks", type=int, default=2, metavar="V",
                     help="llama interleaved schedule: layer chunks per "
                          "device (needs microbatches %% stages == 0 and "
@@ -127,7 +131,8 @@ def run_llama(args, jax, jnp):
           f"attention={'flash' if cfg.use_flash else 'dense'}")
 
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
-    if args.schedule == "interleaved":
+    chunked = args.schedule.startswith("interleaved")
+    if chunked:
         split = lambda p: llama.split_blocks_interleaved(p, S, args.chunks)
     else:
         split = lambda p: llama.split_blocks_for_stages(p, S)
@@ -138,7 +143,8 @@ def run_llama(args, jax, jnp):
     def build_step(c):
         return make_pipeline_train_step(
             c, tx, mesh, M, data_axis="data" if dp > 1 else None,
-            schedule=args.schedule, num_chunks=args.chunks,
+            schedule=args.schedule,
+            num_chunks=args.chunks if chunked else 1,
         )
 
     step = build_step(cfg)

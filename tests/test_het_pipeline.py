@@ -185,3 +185,74 @@ def test_sharded_het_pipeline_param_memory(setup, devices8):
     assert sh_stats.argument_size_in_bytes < rep_stats.argument_size_in_bytes, (
         sh_stats.argument_size_in_bytes, rep_stats.argument_size_in_bytes,
     )
+
+
+@pytest.mark.parametrize("stages", [3, 4])
+def test_het_pipeline_s3_s4_equals_serial(stages, devices8):
+    """The S-generic ResNet stage split (round-5 lift of the S<=2 cap):
+    the S-stage pipelined loss and grads equal the serial composition of
+    the same stages — the reference's flagship 3-stage topology
+    (lab/s01_b2_dp_pp.py:22-29) is now expressible on the benchmark
+    workload."""
+    from ddl25spring_tpu.models.resnet import make_resnet_stages
+
+    S = stages
+    mods = make_resnet_stages(S, width=W)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+    params, shapes, h = [], [], x[:1]
+    for i, sm in enumerate(mods):
+        p = sm.init(jax.random.PRNGKey(i), h)["params"]
+        h = sm.apply({"params": p}, h)
+        params.append(p)
+        shapes.append(h.shape)
+    params = tuple(params)
+
+    def serial(ps, batch):
+        h = batch["x"]
+        for sm, p in zip(mods, ps):
+            h = sm.apply({"params": p}, h)
+        return cross_entropy_logits(h, batch["y"])
+
+    mesh = make_mesh(devices8[:S], stage=S)
+    M, mb = 2, 4
+    fns = [
+        (lambda sm: lambda p, h: sm.apply({"params": p}, h))(sm)
+        for sm in mods
+    ]
+    pipe = make_het_pipeline_loss(
+        fns, lambda logits, b: cross_entropy_logits(logits, b["y"]),
+        (mb, 32, 32, 3), [(mb,) + s[1:] for s in shapes], mesh, M,
+    )
+    batch = {"x": x, "y": y}
+    np.testing.assert_allclose(
+        float(jax.jit(pipe)(params, batch)),
+        float(serial(params, batch)),
+        rtol=1e-5,
+    )
+    g_pipe = jax.jit(jax.grad(pipe))(params, batch)
+    g_serial = jax.grad(serial)(params, batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=5e-4, rtol=5e-3
+        ),
+        g_serial,
+        g_pipe,
+    )
+
+
+def test_build_resnet_step_s3(devices8):
+    """build_resnet_step at the reference flagship topology (dp=2, S=3):
+    one step runs on a (data=2, stage=3) mesh and the loss is finite."""
+    from ddl25spring_tpu.benchmarks import build_resnet_step
+
+    step, params, opt_state, meta = build_resnet_step(
+        devices8[:6], dp=2, S=3, num_microbatches=2, batch=8,
+        dtype=jnp.float32,
+    )
+    assert meta["n_chips"] == 6
+    assert "stage=3" in meta["topology"]
+    x = np.zeros((8, 32, 32, 3), np.uint8)
+    y = np.zeros((8,), np.int32)
+    _, _, loss = step(params, opt_state, (jnp.asarray(x), jnp.asarray(y)))
+    assert np.isfinite(float(loss))

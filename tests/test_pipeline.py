@@ -424,8 +424,8 @@ def test_ep_dp_pp_expert_sharded_equals_dense(cf, devices8):
 
 def test_ep_pipeline_train_step_and_guards(devices8):
     """The EP x DP x PP train step runs (loss falls over steps) and the
-    1F1B schedules refuse ep_axis (stage body under lax.cond — a
-    collective there would sit in non-uniform control flow)."""
+    interleaved schedule still refuses ep_axis (the chunked 5-d expert
+    stacks are not wired for EP sharding)."""
     S, M = 2, 2
     mesh = make_mesh(devices8[:4], data=2, stage=S)
     params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
@@ -444,11 +444,65 @@ def test_ep_pipeline_train_step_and_guards(devices8):
         losses.append(float(loss))
     assert losses[-1] < losses[0]
 
-    with pytest.raises(NotImplementedError, match="1F1B"):
+    with pytest.raises(NotImplementedError):
         make_pipeline_train_step(
-            MOE_CFG, tx, mesh, M, data_axis="data", schedule="1f1b",
-            ep_axis="data",
+            MOE_CFG, tx, mesh, M, data_axis="data", schedule="interleaved",
+            num_chunks=2, ep_axis="data",
         )
+
+
+@pytest.mark.parametrize("cf,stash", [
+    (2.0, "input"), (0.5, "input"), (2.0, "residuals"),
+])
+def test_ep_1f1b_expert_sharded_equals_dense(cf, stash, devices8):
+    """EP x DP x PP under the 1F1B schedules: the forward slot runs the
+    stage body unconditionally (output masked) so the EP all_to_all sits
+    in uniform control flow, and expert-slice grads take the 1/n
+    normalization.  Loss and grads must equal the dense replicated-expert
+    1F1B run EXACTLY — ample capacity and heavy drops alike (routing and
+    capacity are per data shard, decided before the a2a)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(MOE_CFG, capacity_factor=cf)
+    S, M = 2, 2
+    mesh = make_mesh(devices8[:4], data=2, stage=S)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    staged = llama.split_blocks_for_stages(params, S)
+
+    l_dense, g_dense = jax.jit(
+        make_1f1b_value_and_grad(
+            cfg, mesh, M, data_axis="data", stash=stash
+        )
+    )(staged, tokens)
+
+    sharded = shard_staged_params(staged, mesh, ep_axis="data")
+    l_ep, g_ep = jax.jit(
+        make_1f1b_value_and_grad(
+            cfg, mesh, M, data_axis="data", stash=stash, ep_axis="data"
+        )
+    )(sharded, tokens)
+
+    np.testing.assert_allclose(float(l_ep), float(l_dense), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-5, rtol=2e-4
+        ),
+        g_dense,
+        g_ep,
+    )
+    # and the dense 1F1B itself is pinned to GPipe elsewhere; close the
+    # loop cheaply against the serial per-microbatch oracle on the loss
+    def oracle(p):
+        mbs = tokens.reshape(M * 2, tokens.shape[0] // (M * 2), -1)
+
+        def per_mb(mb):
+            logits, aux = llama.llama_forward_with_aux(p, mb, cfg)
+            return causal_lm_loss(logits, mb) + cfg.moe_aux_weight * aux
+
+        return jnp.mean(jax.vmap(per_mb)(mbs))
+
+    np.testing.assert_allclose(float(l_ep), float(oracle(params)), rtol=1e-5)
 
 
 def test_grad_accum_equals_full_batch():
@@ -491,8 +545,11 @@ def test_fused_steps_equal_sequential(schedule, devices8):
     else:
         staged = llama.split_blocks_for_stages(params, S)
     tx = optax.sgd(0.05)
+    # num_chunks only rides the interleaved schedule — passing it with
+    # gpipe now raises (the round-4 advisor's silent-fallback finding)
     step = make_pipeline_train_step(
-        CFG, tx, mesh, M, schedule=schedule, num_chunks=2
+        CFG, tx, mesh, M, schedule=schedule,
+        num_chunks=2 if schedule == "interleaved" else 1,
     )
     tokens_k = jax.random.randint(jax.random.PRNGKey(6), (K, 4, 16), 0, 64)
 
@@ -772,3 +829,267 @@ def test_1f1b_tp_equals_serial(params_and_tokens, stash, devices8):
         g_serial,
         llama.merge_blocks_from_stages(g),
     )
+
+
+@pytest.mark.parametrize("cf", [2.0, 0.5])
+def test_pipeline_tp_moe_equals_serial(cf, devices8):
+    """Switch-MoE under pipeline TP on the full (data, stage, model) mesh:
+    expert stacks shard their expert dim over the tp axis
+    (staged_param_specs n_experts schema), routing stays global per
+    (data-shard, stage, microbatch) group via make_tp_moe_fn, and the
+    block's row-parallel psum completes the partial combine — so loss and
+    grads equal the serial per-microbatch oracle EXACTLY, at ample
+    capacity (cf=2.0) and under heavy drops (cf=0.5) alike."""
+    import dataclasses
+
+    cfg = dataclasses.replace(MOE_CFG, capacity_factor=cf)
+    S, T, dp, M = 2, 2, 2, 2
+    mesh = make_mesh(devices8[: dp * S * T], data=dp, stage=S, model=T)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    # sharpen router margins: TP's psum reorders fp summation by ulps,
+    # and with the near-uniform init logits a ulp can flip a near-tie
+    # routing decision under tight capacity — the test pins the drop
+    # MECHANISM (global capacity, identical bucketing on every shard),
+    # not fp tie-breaking, so give the router decisive margins
+    params["blocks"]["moe"]["router"] = (
+        30.0 * params["blocks"]["moe"]["router"]
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    staged = llama.split_blocks_for_stages(params, S)
+
+    sharded = shard_staged_params(staged, mesh, tp_axis="model")
+    w = sharded["blocks"]["moe"]["w_gate"]
+    assert w.addressable_shards[0].data.shape[2] == cfg.n_experts // T, (
+        "expert stacks not sharded over the model axis"
+    )
+
+    loss = make_pipeline_loss(
+        cfg, mesh, M, data_axis="data", tp_axis="model"
+    )
+    l_pipe, g_pipe = jax.jit(jax.value_and_grad(loss))(sharded, tokens)
+
+    # per-microbatch oracle at THIS cf (serial_moe_loss is pinned to
+    # MOE_CFG's ample capacity): dp shards the microbatch dim -> M*dp
+    # per-replica dispatch groups
+    def oracle(p):
+        mbs = tokens.reshape(M * dp, tokens.shape[0] // (M * dp), -1)
+
+        def per_mb(mb):
+            logits, aux = llama.llama_forward_with_aux(p, mb, cfg)
+            return causal_lm_loss(logits, mb) + cfg.moe_aux_weight * aux
+
+        return jnp.mean(jax.vmap(per_mb)(mbs))
+
+    l_serial = float(oracle(params))
+    np.testing.assert_allclose(float(l_pipe), l_serial, rtol=1e-5)
+
+    g_serial = jax.grad(oracle)(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_from_stages(g_pipe),
+    )
+
+
+@pytest.mark.parametrize("stash", ["input", "residuals"])
+def test_1f1b_tp_moe_equals_serial(stash, devices8):
+    """MoE x TP inside the hand-rolled 1F1B backward: the router grad is
+    replicated across tp (pmean re-typing) while the expert slices follow
+    the 1/t matmul normalization — pinned against the serial oracle, for
+    both the remat and residual-stash backward variants."""
+    S, T, M = 2, 2, 2
+    mesh = make_mesh(devices8[: S * T], stage=S, model=T)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    staged = llama.split_blocks_for_stages(params, S)
+
+    l, g = jax.jit(
+        make_1f1b_value_and_grad(
+            MOE_CFG, mesh, M, tp_axis="model", stash=stash
+        )
+    )(staged, tokens)
+    l_serial = float(serial_moe_loss(params, tokens, M))
+    np.testing.assert_allclose(float(l), l_serial, rtol=1e-5)
+    g_serial = jax.grad(lambda p: serial_moe_loss(p, tokens, M))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_from_stages(g),
+    )
+
+
+def test_interleaved_tp_moe_equals_serial(devices8):
+    """MoE x TP x the interleaved virtual-stage schedule: the chunked
+    5-d expert stacks shard their expert dim over tp."""
+    S, V, M, T = 2, 2, 2, 2
+    mesh = make_mesh(devices8[: S * T], stage=S, model=T)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    staged = llama.split_blocks_interleaved(params, S, V)
+    loss = make_interleaved_pipeline_loss(
+        MOE_CFG, mesh, M, V, tp_axis="model"
+    )
+    np.testing.assert_allclose(
+        float(jax.jit(loss)(staged, tokens)),
+        float(serial_moe_loss(params, tokens, M)),
+        rtol=1e-5,
+    )
+    g = jax.jit(jax.grad(loss))(staged, tokens)
+    g_serial = jax.grad(lambda p: serial_moe_loss(p, tokens, M))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_interleaved(g),
+    )
+
+
+# ------------------------------------------------------- interleaved 1F1B
+
+
+@pytest.mark.parametrize("stages,chunks,microbatches,dp,tp", [
+    (2, 2, 2, 1, 1),
+    (2, 3, 4, 1, 1),
+    (4, 2, 4, 1, 1),
+    (2, 2, 4, 2, 2),
+])
+def test_interleaved_1f1b_equals_serial(
+    stages, chunks, microbatches, dp, tp, devices8
+):
+    """The production Megatron schedule — interleaved virtual stages WITH
+    the memory-bounded hand-rolled 1F1B backward: loss and grads must
+    equal the serial model across chunk counts, stage counts, and the
+    full DP x PP x TP composition (the backward stream's reversed slot
+    map and ring indexing are what this pins)."""
+    S, V, M = stages, chunks, microbatches
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=S * V, ctx_size=16,
+        dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (M * dp * 2, 16), 0, 64
+    )
+
+    def serial(p):
+        return causal_lm_loss(llama.llama_forward(p, tokens, cfg), tokens)
+
+    kw = {}
+    names = {"stage": S}
+    if dp > 1:
+        names = {"data": dp, "stage": S}
+        kw["data_axis"] = "data"
+    if tp > 1:
+        names["model"] = tp
+        kw["tp_axis"] = "model"
+    mesh = make_mesh(devices8[: S * dp * tp], **names)
+    staged = llama.split_blocks_interleaved(params, S, V)
+    l, g = jax.jit(
+        make_1f1b_value_and_grad(cfg, mesh, M, num_chunks=V, **kw)
+    )(staged, tokens)
+    np.testing.assert_allclose(float(l), float(serial(params)), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        jax.grad(serial)(params),
+        llama.merge_blocks_interleaved(g),
+    )
+
+
+def test_interleaved_1f1b_moe_equals_serial(devices8):
+    """Switch-MoE rides interleaved 1F1B: every (chunk, microbatch)
+    backward slot banks its chunk's weighted aux term."""
+    S, V, M = 2, 2, 2
+    mesh = make_mesh(devices8[:S], stage=S)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    staged = llama.split_blocks_interleaved(params, S, V)
+    l, g = jax.jit(
+        make_1f1b_value_and_grad(MOE_CFG, mesh, M, num_chunks=V)
+    )(staged, tokens)
+    np.testing.assert_allclose(
+        float(l), float(serial_moe_loss(params, tokens, M)), rtol=1e-5
+    )
+    g_serial = jax.grad(lambda p: serial_moe_loss(p, tokens, M))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        g_serial,
+        llama.merge_blocks_interleaved(g),
+    )
+
+
+def test_interleaved_1f1b_bounds_activation_memory(devices8):
+    """The point of composing the two schedules: at V=2 the interleaved
+    scan-transpose saves every chunk-tick's residuals (O(M·V)); the
+    interleaved 1F1B ring-stashes 2VS-1 chunk inputs and rematerializes —
+    compiled temp memory must be several times smaller at M=8."""
+    cfg = LlamaConfig(
+        vocab_size=128, dmodel=32, num_heads=2, n_layers=4, ctx_size=256,
+        dtype="float32",
+    )
+    S, V, M = 2, 2, 8
+    mesh = make_mesh(devices8[:S], stage=S)
+    staged = shard_staged_params(
+        llama.split_blocks_interleaved(
+            llama.init_llama_params(jax.random.PRNGKey(0), cfg), S, V
+        ),
+        mesh, chunked=True,
+    )
+    tx = optax.adam(1e-3)
+    opt = tx.init(staged)
+    tokens = jnp.zeros((M, cfg.ctx_size), jnp.int32)
+
+    temps = {}
+    for sched in ("interleaved", "interleaved-1f1b"):
+        step = make_pipeline_train_step(
+            cfg, tx, mesh, M, schedule=sched, num_chunks=V
+        )
+        stats = step.lower(staged, opt, tokens).compile().memory_analysis()
+        temps[sched] = stats.temp_size_in_bytes
+    assert temps["interleaved-1f1b"] * 2 < temps["interleaved"], temps
+
+
+def test_interleaved_1f1b_train_step_and_guards(devices8):
+    """The train-step builder dispatches the interleaved-1f1b schedule
+    (loss falls over steps) and the guards hold: residual stash and EP
+    are not wired for chunked stacks, num_chunks >= 2 required."""
+    S, V, M = 2, 2, 2
+    mesh = make_mesh(devices8[:S], stage=S)
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=2, n_layers=S * V, ctx_size=16,
+        dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    staged = shard_staged_params(
+        llama.split_blocks_interleaved(params, S, V), mesh, chunked=True
+    )
+    tx = optax.adam(1e-2)
+    step = make_pipeline_train_step(
+        cfg, tx, mesh, M, schedule="interleaved-1f1b", num_chunks=V
+    )
+    opt = tx.init(staged)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+    losses = []
+    for _ in range(5):
+        staged, opt, loss = step(staged, opt, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    with pytest.raises(NotImplementedError, match="residual"):
+        make_1f1b_value_and_grad(
+            cfg, mesh, M, stash="residuals", num_chunks=V
+        )
+    with pytest.raises(ValueError, match="num_chunks"):
+        make_pipeline_train_step(
+            cfg, tx, mesh, M, schedule="interleaved-1f1b", num_chunks=1
+        )
+    with pytest.raises(ValueError, match="divisible"):
+        make_1f1b_value_and_grad(cfg, mesh, 3, num_chunks=V)
