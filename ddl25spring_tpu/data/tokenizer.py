@@ -87,14 +87,27 @@ class ByteTokenizer:
 
 
 class SentencePieceTokenizer:
-    """Wrapper matching simplellm's ``SPTokenizer`` surface, gated on the
-    sentencepiece package being available (it is host-side C++, off the TPU
-    hot path)."""
+    """Wrapper matching simplellm's ``SPTokenizer`` surface.
+
+    Uses the sentencepiece package (host-side C++) when importable;
+    otherwise the in-tree pure-Python processor
+    (:class:`~ddl25spring_tpu.data.sp_model.PySentencePieceProcessor`),
+    which reads the SAME ``.model`` protobuf format and encodes by
+    unigram Viterbi — so real SentencePiece artifacts work on images
+    without the package (this one), and the in-tree-trained artifact
+    works under real SentencePiece."""
 
     def __init__(self, model_path: str):
-        import sentencepiece as spm  # gated import
+        try:
+            import sentencepiece as spm  # gated import
 
-        self._sp = spm.SentencePieceProcessor(model_file=model_path)
+            self._sp = spm.SentencePieceProcessor(model_file=model_path)
+        except ImportError:
+            from ddl25spring_tpu.data.sp_model import (
+                PySentencePieceProcessor,
+            )
+
+            self._sp = PySentencePieceProcessor(model_path)
         self.vocab_size = self._sp.vocab_size()
         # keep SentencePiece's -1 sentinel when the model has no pad piece:
         # coercing to 0 would alias <unk> and silently mask it out of losses

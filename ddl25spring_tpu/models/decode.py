@@ -42,19 +42,31 @@ from ddl25spring_tpu.utils.config import LlamaConfig
 Params = dict[str, Any]
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int):
-    """``(k, v)`` stacked over layers: ``[L, B, max_len, H, hd]``."""
+def init_kv_cache(
+    cfg: LlamaConfig, batch: int, max_len: int, num_heads: int | None = None
+):
+    """``(k, v)`` stacked over layers: ``[L, B, max_len, H, hd]``.
+    ``num_heads`` overrides the config for TP decode, where each shard
+    caches only its local ``H/t`` heads."""
     shape = (
-        cfg.n_layers, batch, max_len, cfg.num_heads, cfg.head_dim
+        cfg.n_layers, batch, max_len, num_heads or cfg.num_heads,
+        cfg.head_dim,
     )
     dtype = jnp.dtype(cfg.dtype)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
 def _block_decode(p: Params, x, k_cache, v_cache, pos, cos, sin,
-                  cfg: LlamaConfig):
+                  cfg: LlamaConfig, tp_axis: str | None = None):
     """One block on a single-token slice ``x [B, 1, D]`` against the
-    layer's cache ``[B, max_len, H, hd]``; returns updated caches."""
+    layer's cache ``[B, max_len, H, hd]``; returns updated caches.
+
+    ``tp_axis``: Megatron TP inside ``shard_map`` — ``p`` holds this
+    shard's column slice of wq/wk/wv (local heads fall out of the
+    reshape) and row slice of wo/w_down; the two row-parallel matmuls
+    are completed by a ``psum``, exactly the training-path layout
+    (``llama.block_forward``), so TP decode reads the SAME sharded
+    weights training produced.  The KV cache is head-sharded."""
     dtype = jnp.dtype(cfg.dtype)
     B = x.shape[0]
     hd = cfg.head_dim
@@ -80,35 +92,71 @@ def _block_decode(p: Params, x, k_cache, v_cache, pos, cos, sin,
     s = jnp.where(live[None, None, None, :], s, -1e30)
     probs = jax.nn.softmax(s, axis=-1).astype(dtype)
     attn = jnp.einsum("bhqm,bmhd->bqhd", probs, v_cache)
-    x = x + attn.reshape(B, 1, -1) @ p["wo"].astype(dtype)
+    attn_out = attn.reshape(B, 1, -1) @ p["wo"].astype(dtype)
+    if tp_axis is not None:
+        attn_out = lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = llama.rms_norm(x, p["ln2"])
     if cfg.n_experts > 0:
-        from ddl25spring_tpu.parallel.ep import moe_ffn
-
-        # ample decode-time capacity (C = B): dropping tokens is a
-        # TRAINING regularization artifact; at inference a drop would
+        # ample decode-time capacity (C >= B*top_k): dropping tokens is
+        # a TRAINING regularization artifact; at inference a drop would
         # silently zero a token's FFN, so decode never drops — and the
         # teacher-forcing oracle holds whenever the full forward didn't
         # drop either
-        y, _ = moe_ffn(
-            p["moe"], h.reshape(B, -1),
-            capacity_factor=float(p["moe"]["router"].shape[1]),
-            top_k=cfg.moe_top_k,
-        )
-        x = x + y.reshape(B, 1, -1).astype(dtype)
+        E = p["moe"]["router"].shape[1]
+        if tp_axis is not None:
+            from ddl25spring_tpu.parallel.tp import make_tp_moe_fn
+
+            # global routing on every shard, local E/t expert slice,
+            # partial combine completed by the psum below
+            y, _ = make_tp_moe_fn(
+                tp_axis, capacity_factor=float(E), top_k=cfg.moe_top_k
+            )(p["moe"], h.reshape(B, -1))
+        else:
+            from ddl25spring_tpu.parallel.ep import moe_ffn
+
+            y, _ = moe_ffn(
+                p["moe"], h.reshape(B, -1),
+                capacity_factor=float(E),
+                top_k=cfg.moe_top_k,
+            )
+        ffn_out = y.reshape(B, 1, -1).astype(dtype)
     else:
         gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
         up = h @ p["w_up"].astype(dtype)
-        x = x + (gate * up) @ p["w_down"].astype(dtype)
-    return x, k_cache, v_cache
+        ffn_out = (gate * up) @ p["w_down"].astype(dtype)
+    if tp_axis is not None:
+        ffn_out = lax.psum(ffn_out, tp_axis)
+    return x + ffn_out, k_cache, v_cache
 
 
-def decode_step(params: Params, cache, tokens_t, pos, cfg: LlamaConfig):
+def decode_step(
+    params: Params,
+    cache,
+    tokens_t,
+    pos,
+    cfg: LlamaConfig,
+    tp_axis: str | None = None,
+    shard_vocab: bool = False,
+):
     """One incremental step: ``tokens_t [B]`` at position ``pos`` ->
-    ``(logits [B, V], cache)``."""
+    ``(logits [B, V], cache)``.
+
+    Under ``tp_axis`` with ``shard_vocab`` the embed table is the local
+    ``[V/t, D]`` slice (Megatron parallel embedding, one psum) and the
+    unembed emits a ``[B, V/t]`` logit slice that one ``all_gather``
+    assembles to the full ``[B, V]`` — the only full-vocab array decode
+    ever materializes, needed because sampling is a global decision."""
     k_all, v_all = cache
-    x = llama.embed(params, tokens_t[:, None], cfg)  # [B, 1, D]
+    if shard_vocab:
+        from ddl25spring_tpu.parallel.tp import vocab_sharded_embed
+
+        x = vocab_sharded_embed(
+            params["embed"], tokens_t[:, None], tp_axis, jnp.dtype(cfg.dtype)
+        )
+    else:
+        x = llama.embed(params, tokens_t[:, None], cfg)  # [B, 1, D]
     # rotary phases depend only on the position — computed once per step,
     # shared by every layer
     cos, sin = llama.rope_angles(
@@ -117,11 +165,17 @@ def decode_step(params: Params, cache, tokens_t, pos, cfg: LlamaConfig):
 
     def layer(x, inputs):
         block_p, kc, vc = inputs
-        x, kc, vc = _block_decode(block_p, x, kc, vc, pos, cos, sin, cfg)
+        x, kc, vc = _block_decode(
+            block_p, x, kc, vc, pos, cos, sin, cfg, tp_axis=tp_axis
+        )
         return x, (kc, vc)
 
     x, (k_all, v_all) = lax.scan(layer, x, (params["blocks"], k_all, v_all))
     logits = llama.unembed(params, x, cfg)[:, 0]
+    if shard_vocab:
+        # shard i holds vocab columns [i*V/t, (i+1)*V/t): index-ordered
+        # concat reassembles the true vocab order
+        logits = lax.all_gather(logits, tp_axis, axis=1, tiled=True)
     return logits, (k_all, v_all)
 
 
@@ -180,6 +234,8 @@ def generate(
     max_len: int | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    tp_axis: str | None = None,
+    shard_vocab: bool = False,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
@@ -188,6 +244,12 @@ def generate(
     optionally truncated to the ``top_k`` highest logits and/or the
     ``top_p`` probability nucleus (``sample_logits``).  Jittable end to
     end (prefill scan + decode scan, static shapes).
+
+    ``tp_axis``: for calls INSIDE a ``shard_map`` over a TP mesh axis —
+    params carry the :func:`~ddl25spring_tpu.parallel.tp.tp_param_specs`
+    layout, the KV cache is head-sharded, and every shard samples the
+    identical token stream (same key, same assembled logits).  Use
+    :func:`make_tp_generate` for the jitted entry point.
     """
     B, P = prompt.shape
     L_max = max_len or (P + max_new_tokens)
@@ -199,19 +261,36 @@ def generate(
         )
     if key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_kv_cache(cfg, B, L_max)
+    # local head count from the param slice (H/t under TP, H otherwise)
+    heads = params["blocks"]["wq"].shape[-1] // cfg.head_dim
+    cache = init_kv_cache(cfg, B, L_max, num_heads=heads)
+
+    def vary(x):
+        # scan carries must hold a stable VMA type: the cache starts as
+        # invariant zeros but becomes tp-varying at the first head-slice
+        # write.  Logits are varying only under shard_vocab (local slices
+        # all_gathered); without it the row-parallel psums leave the
+        # activations — and hence logits — invariant.
+        if tp_axis is None:
+            return x
+        return lax.pcast(x, (tp_axis,), to="varying")
+
+    vary_logits = vary if shard_vocab else (lambda x: x)
+    cache = jax.tree.map(vary, cache)
 
     # prefill: feed prompt tokens through the cached step (logits of the
     # last prompt token seed the first generated one)
     def pre(carry, inp):
         cache, _ = carry
         t, pos = inp
-        logits, cache = decode_step(params, cache, t, pos, cfg)
+        logits, cache = decode_step(
+            params, cache, t, pos, cfg, tp_axis, shard_vocab
+        )
         return (cache, logits), None
 
     (cache, logits), _ = lax.scan(
         pre,
-        (cache, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
+        (cache, vary_logits(jnp.zeros((B, cfg.vocab_size), jnp.float32))),
         (prompt.T, jnp.arange(P)),
     )
 
@@ -223,10 +302,73 @@ def generate(
         pos = inp
         key, sub = jax.random.split(key)
         tok = pick(logits, sub)
-        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        logits, cache = decode_step(
+            params, cache, tok, pos, cfg, tp_axis, shard_vocab
+        )
         return (cache, logits, key), tok
 
     (_, _, _), toks = lax.scan(
         step, (cache, logits, key), P + jnp.arange(max_new_tokens)
     )
     return toks.T  # [B, max_new_tokens]
+
+
+def make_tp_generate(
+    cfg: LlamaConfig,
+    mesh,
+    max_new_tokens: int,
+    model_axis: str = "model",
+    shard_vocab: bool = True,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    max_len: int | None = None,
+):
+    """TP-sharded generation: ``gen(params, prompt, key) -> [B, new]``.
+
+    Serving-side counterpart of the TP training step
+    (:mod:`ddl25spring_tpu.parallel.tp`): params stay in the exact layout
+    training produced (column/row-split matmuls, vocab-sharded
+    embed/unembed when ``shard_vocab``), attention heads and the KV
+    cache shard over ``model_axis``, and the per-step communication is
+    the two row-parallel psums plus one ``[B, V]`` logits all_gather.
+    Every shard runs the identical sampling chain (invariant key, equal
+    assembled logits), so generation is exactly the single-device
+    :func:`generate` — pinned in ``tests/test_decode.py``."""
+    from functools import partial as _partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ddl25spring_tpu.parallel.tp import tp_param_specs
+
+    if cfg.num_heads % mesh.shape[model_axis]:
+        raise ValueError(
+            f"num_heads ({cfg.num_heads}) not divisible by "
+            f"{model_axis}={mesh.shape[model_axis]}"
+        )
+    specs = tp_param_specs(model_axis, shard_vocab, cfg.n_experts)
+
+    @jax.jit
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(specs, P(), P()),
+        out_specs=P(),
+    )
+    def gen(params, prompt, key):
+        toks = generate(
+            params, prompt, cfg, max_new_tokens,
+            temperature=temperature, key=key, max_len=max_len,
+            top_k=top_k, top_p=top_p,
+            tp_axis=model_axis, shard_vocab=shard_vocab,
+        )
+        if shard_vocab:
+            # every shard holds the identical stream; pmax is an
+            # idempotent re-type to the invariant out_spec (psum would
+            # scale by t).  Without shard_vocab the logits — and the
+            # sampled stream — are already invariant.
+            toks = lax.pmax(toks, model_axis)
+        return toks
+
+    return gen

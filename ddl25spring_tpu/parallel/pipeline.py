@@ -192,6 +192,21 @@ def _tp_moe_fn(cfg: LlamaConfig, tp_axis: str):
     return make_tp_moe_fn(tp_axis, cfg.capacity_factor, cfg.moe_top_k)
 
 
+def _slot_map(k, V: int, S: int, M: int):
+    """Megatron's interleaved slot grouping — THE single source of the
+    schedule: slot ``k`` maps to chunk ``v`` and microbatch ``m`` by
+    ``g, j = divmod(k, V*S); v, r = divmod(j, S); m = g*S + r`` (each
+    device runs chunk 0 for a group of S microbatches, then chunk 1 for
+    the same group, ...).  Returns ``(v, m, r, g)`` with ``k`` clamped
+    into range (drain ticks); the interleaved-1F1B backward derives its
+    mirrored stream (chunk reversal + forward-slot reconstruction) from
+    the same quadruple.  See :func:`make_interleaved_pipeline_loss` for
+    the timing proof."""
+    g, j = jnp.divmod(jnp.clip(k, 0, M * V - 1), V * S)
+    v, r = jnp.divmod(j, S)
+    return v, g * S + r, r, g
+
+
 def make_pipeline_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -202,6 +217,8 @@ def make_pipeline_loss(
     ep_axis: str | None = None,
     num_chunks: int = 1,
     tp_axis: str | None = None,
+    seq_axis: str | None = None,
+    sp_mode: str = "ring",
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
 
@@ -252,11 +269,47 @@ def make_pipeline_loss(
     so the final ``pmean`` over the axis only normalizes the varying
     type — and its transpose restores each member's full cotangent,
     making sharded-weight grads exact (pinned vs serial in tests).
+
+    ``seq_axis``: sequence parallelism INSIDE each stage — long-context
+    x staged model (SP x (DP x) PP).  Tokens shard their LENGTH dim over
+    the axis (each device holds ``[mb, L/n]`` of every microbatch);
+    every block runs ring attention (``sp_mode="ring"``; flash local
+    step per ``cfg.use_flash``) or Ulysses all-to-all attention at
+    global RoPE positions, and the finishing stage takes the
+    sequence-sharded causal loss (one boundary-token ppermute + psum
+    pair — :func:`~ddl25spring_tpu.parallel.sp.sp_causal_lm_loss`).
+    Activations crossing stage boundaries stay sequence-sharded, so the
+    per-device boundary traffic ALSO falls by ``n``.  Dense blocks,
+    plain schedule only (``n_experts``/``ep_axis``/``tp_axis``/
+    ``num_chunks`` compositions with SP are guarded off).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
     V = num_chunks
     dtype = jnp.dtype(cfg.dtype)
+    if seq_axis is not None:
+        if cfg.n_experts > 0 or ep_axis is not None:
+            raise NotImplementedError(
+                "SP inside the pipeline ships dense blocks; the sharded "
+                "MoE aux estimator under a seq axis is not wired"
+            )
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "seq_axis and tp_axis inside the same pipeline stage is "
+                "not wired (head-sharded ring attention untested)"
+            )
+        if V > 1:
+            raise NotImplementedError(
+                "seq_axis rides the plain (num_chunks=1) gpipe schedule"
+            )
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(f"unknown SP mode {sp_mode!r}")
+        n_seq = mesh.shape[seq_axis]
+        if sp_mode == "ulysses" and cfg.num_heads % n_seq:
+            raise ValueError(
+                f"ulysses SP needs num_heads ({cfg.num_heads}) divisible "
+                f"by the {seq_axis!r} axis size ({n_seq})"
+            )
     if V > 1:
         if ep_axis is not None:
             raise NotImplementedError(
@@ -279,7 +332,8 @@ def make_pipeline_loss(
         # shard_map; ep_moe_local pcasts it over the EP(=data) axis
         moe_fn = _ep_moe_fn(cfg, mesh, ep_axis, data_axis, (ep_axis,))
 
-    tok_spec = P(None, data_axis)  # [M, mb, L]: shard microbatch dim over data
+    # [M, mb, L]: microbatch dim shards over data, length over seq
+    tok_spec = P(None, data_axis, seq_axis)
 
     @partial(
         shard_map,
@@ -301,7 +355,34 @@ def make_pipeline_loss(
             (stage_axis,)
             + ((data_axis,) if data_axis else ())
             + ((tp_axis,) if tp_axis else ())
+            + ((seq_axis,) if seq_axis else ())
         )
+
+        if seq_axis is not None:
+            from ddl25spring_tpu.parallel.sp import make_sp_attn_fn
+
+            # L above is the LOCAL shard length; attention needs global
+            # RoPE positions and the SP attention implementation
+            pos = lax.axis_index(seq_axis) * L + jnp.arange(L)
+            sp_attn = make_sp_attn_fn(cfg, seq_axis, sp_mode, pos)
+            block_kw = {
+                "pos": pos,
+                "attn_fn": lambda q, k, v, dtype: sp_attn(q, k, v, dtype=dtype),
+            }
+            # Sequence-sharded causal targets, computed BEFORE the scan:
+            # the boundary token (next shard's first) comes from ONE
+            # ppermute over the whole [M, mb, 1] token slab — tokens are
+            # static, so no per-tick collective is needed, and the loss
+            # inside the finish cond stays purely local.  Collectives
+            # inside that cond would execute on last-stage devices only:
+            # a collective sequence that differs across the stage axis
+            # deadlocks the matching engine (observed on the CPU mesh).
+            from ddl25spring_tpu.parallel.sp import sp_shifted_targets
+
+            targets_mb, valid_row = sp_shifted_targets(tokens_mb, seq_axis)
+        else:
+            block_kw = {}
+            targets_mb = tokens_mb
 
         # Varying copies of the embed/unembed params, cast OUTSIDE the scan:
         # their cotangent psum (the transpose of this pcast) then executes
@@ -328,9 +409,7 @@ def make_pipeline_loss(
                 inject = s == 0
                 finish = s == S - 1
             else:
-                g, j = jnp.divmod(jnp.clip(k, 0, M * V - 1), V * S)
-                v, r = jnp.divmod(j, S)
-                m = g * S + r
+                v, m, _, _ = _slot_map(k, V, S, M)
                 chunk = jax.tree.map(
                     lambda x: lax.dynamic_index_in_dim(
                         x, v, 0, keepdims=False
@@ -354,19 +433,36 @@ def make_pipeline_loss(
                 w_f = jnp.where(active, 1.0, 0.0).astype(jnp.float32)
                 aux_term = w_f * jnp.float32(cfg.moe_aux_weight) * aux
             else:
-                x_out = llama.apply_blocks(chunk, x_in, cfg, tp_axis=tp_axis)
+                x_out = llama.apply_blocks(
+                    chunk, x_in, cfg, tp_axis=tp_axis, **block_kw
+                )
                 aux_term = jnp.float32(0.0)
 
             # the last (virtual) stage finishes microbatch m on this tick.
             # lax.cond so non-last stages skip the unembed matmul entirely;
             # the zero branch must carry the same varying-axis type as the
             # loss branch (JAX 0.9 shard_map VMA typing)
+            if seq_axis is not None:
+                # collective-free local CE SUM over this shard's
+                # positions (targets + mask precomputed above); the
+                # cross-shard psum and the mean normalization happen
+                # once, after the scan
+                from ddl25spring_tpu.parallel.sp import sp_local_ce_sum
+
+                def loss_branch(x, y):
+                    return sp_local_ce_sum(
+                        llama.unembed(head, x, cfg), y, valid_row
+                    )
+            else:
+                def loss_branch(x, y):
+                    return causal_lm_loss(llama.unembed(head, x, cfg), y)
+
             loss_mb = lax.cond(
                 jnp.logical_and(finish, active),
-                lambda x, y: causal_lm_loss(llama.unembed(head, x, cfg), y),
+                loss_branch,
                 lambda x, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
-                tokens_mb[m],
+                targets_mb[m],
             )
 
             # hand activation to the next stage: the isend/irecv chain of
@@ -387,7 +483,17 @@ def make_pipeline_loss(
             tick_fn, carry0, jnp.arange(M * V + S - 1)
         )
 
-        total = lax.psum(loss_sum, stage_axis) / M
+        total = lax.psum(loss_sum, stage_axis)
+        if seq_axis is not None:
+            # the ticks banked LOCAL CE sums; one psum over seq and the
+            # global-token-count mean reproduce the serial causal loss
+            # (L here is the local shard length)
+            n_seq = lax.psum(1, seq_axis)
+            total = lax.psum(total, seq_axis) / (
+                M * mb * (L * n_seq - 1)
+            )
+        else:
+            total = total / M
         if data_axis is not None:
             total = lax.pmean(total, data_axis)
         if tp_axis is not None:
@@ -701,30 +807,27 @@ def make_1f1b_value_and_grad(
             )
 
         def fwd_slot(k):
-            """Megatron slot map (see make_interleaved_pipeline_loss):
-            forward slot ``k`` -> (chunk ``v``, microbatch ``m``, and the
-            inject/finish/skip flags for this device)."""
+            """Megatron slot map (``_slot_map``): forward slot ``k`` ->
+            (chunk ``v``, microbatch ``m``, and the inject/finish flags
+            for this device)."""
             if V == 1:
                 m = jnp.clip(k, 0, M - 1)
                 return 0, m, s == 0, is_last
-            g, j = jnp.divmod(jnp.clip(k, 0, M * V - 1), V * S)
-            v, r = jnp.divmod(j, S)
-            m = g * S + r
+            v, m, _, _ = _slot_map(k, V, S, M)
             return v, m, jnp.logical_and(s == 0, v == 0), jnp.logical_and(
                 is_last, v == V - 1
             )
 
         def bwd_slot(k_b):
-            """The mirrored backward stream: slot ``k_b`` maps through the
-            SAME grouping onto REVERSED chunks, plus the ring index of the
-            matching forward slot (where its input was stashed)."""
+            """The mirrored backward stream: slot ``k_b`` maps through
+            the SAME ``_slot_map`` grouping onto REVERSED chunks, plus
+            the ring index of the matching forward slot (where its input
+            was stashed)."""
             if V == 1:
                 m = jnp.clip(k_b, 0, M - 1)
                 return 0, m, jnp.clip(k_b, 0, M - 1), s == 0, is_last
-            g, j = jnp.divmod(jnp.clip(k_b, 0, M * V - 1), V * S)
-            v_rev, r = jnp.divmod(j, S)
+            v_rev, m, r, g = _slot_map(k_b, V, S, M)
             v = V - 1 - v_rev
-            m = g * S + r
             k_fwd = g * V * S + v * S + r  # forward slot of (v, m)
             return v, m, k_fwd, jnp.logical_and(s == 0, v == 0), (
                 jnp.logical_and(is_last, v == V - 1)
@@ -1050,6 +1153,8 @@ def make_pipeline_train_step(
     ep_axis: str | None = None,
     num_chunks: int = 1,
     tp_axis: str | None = None,
+    seq_axis: str | None = None,
+    sp_mode: str = "ring",
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -1079,7 +1184,17 @@ def make_pipeline_train_step(
     ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on EVERY
     schedule; pass params through ``shard_staged_params(..., tp_axis=...)``
     (adding ``chunked=True`` for the interleaved 5-d stacks).
+
+    ``seq_axis``: sequence parallelism inside each stage (SP x (DP x)
+    PP, gpipe schedule only — see :func:`make_pipeline_loss`); tokens
+    shard their length dim over the axis, ``sp_mode`` picks
+    ring/ulysses attention.
     """
+    if seq_axis is not None and schedule != "gpipe":
+        raise NotImplementedError(
+            "seq_axis rides the gpipe schedule only (the hand-rolled "
+            "1F1B backwards are not wired for sequence-sharded stages)"
+        )
     if num_chunks > 1 and schedule not in ("interleaved", "interleaved-1f1b"):
         # silently falling back to plain GPipe would train a different
         # schedule than asked for AND fail later at shard_map spec-rank
@@ -1115,7 +1230,8 @@ def make_pipeline_train_step(
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
-            ep_axis=ep_axis, tp_axis=tp_axis,
+            ep_axis=ep_axis, tp_axis=tp_axis, seq_axis=seq_axis,
+            sp_mode=sp_mode,
         )
         vag = jax.value_and_grad(loss_fn)
     else:

@@ -228,6 +228,79 @@ def ulysses_attention(q, k, v, axis: str, dtype, use_flash: bool = True):
     )
 
 
+def sp_shifted_targets(tokens: jax.Array, seq_axis: str):
+    """``(targets, valid)`` for the sequence-sharded causal loss: the
+    target of a shard's LAST token is the NEXT shard's first token — one
+    single-token ``ppermute`` fetches it (the only cross-shard data the
+    loss needs) — and the final shard's last position has no target
+    (masked), matching the serial loss over ``L_global - 1`` positions.
+
+    ``tokens`` may carry leading batch-like dims (``[..., B, Ll]``); the
+    ppermute/concat/mask act on the last dim.  Collective-free consumers
+    (the pipeline's per-tick loss, whose collectives must stay out of
+    ``lax.cond``) call this ONCE up front and use
+    :func:`sp_local_ce_sum` per tick."""
+    n = lax.psum(1, seq_axis)
+    Ll = tokens.shape[-1]
+    nxt = lax.ppermute(
+        tokens[..., :1], seq_axis, [((i + 1) % n, i) for i in range(n)]
+    )
+    targets = jnp.concatenate([tokens[..., 1:], nxt], axis=-1)
+    is_last_shard = lax.axis_index(seq_axis) == n - 1
+    valid = jnp.where(
+        is_last_shard & (jnp.arange(Ll) == Ll - 1), 0.0, 1.0
+    )
+    return targets, valid
+
+
+def sp_local_ce_sum(logits, targets, valid) -> jax.Array:
+    """Collective-free local CE SUM over one shard's positions
+    (``logits [B, Ll, V]``, ``targets [B, Ll]``, ``valid [Ll]`` from
+    :func:`sp_shifted_targets`); callers psum/normalize across shards."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return -(picked * valid[None, :]).sum()
+
+
+def sp_causal_lm_loss(
+    logits: jax.Array, tokens: jax.Array, seq_axis: str
+) -> jax.Array:
+    """Causal-LM loss over sequence-sharded ``logits [B, Ll, V]`` /
+    ``tokens [B, Ll]`` (inside ``shard_map``; shard ``s`` holds
+    contiguous global positions ``[s*Ll, (s+1)*Ll)``).  Returns the
+    seq-invariant global mean (one psum pair).  Shared by
+    :func:`make_sp_loss` and the pipeline's ``seq_axis`` mode (which
+    splits it into :func:`sp_shifted_targets` + :func:`sp_local_ce_sum`
+    so no collective lands inside its tick cond)."""
+    B, Ll = tokens.shape
+    targets, valid = sp_shifted_targets(tokens, seq_axis)
+    local_sum = sp_local_ce_sum(logits, targets, valid)
+    local_cnt = (valid[None, :] * jnp.ones((B, 1))).sum()
+    return lax.psum(local_sum, seq_axis) / lax.psum(local_cnt, seq_axis)
+
+
+def make_sp_attn_fn(cfg: LlamaConfig, seq_axis: str, mode: str, pos):
+    """The attention implementation a sequence-sharded forward injects
+    into ``block_forward``: ring (dense or flash local step per
+    ``cfg.use_flash``) or Ulysses all-to-all.  ``pos`` is the shard's
+    global-position vector (ring mode's per-pair causal mask needs it).
+    Shared by :func:`make_sp_loss` and the pipeline's ``seq_axis``
+    mode."""
+    if mode == "ulysses":
+        def attn(q, k, v, dtype):
+            return ulysses_attention(
+                q, k, v, seq_axis, dtype, use_flash=cfg.use_flash
+            )
+
+        return attn
+    if cfg.use_flash:
+        def attn(q, k, v, dtype):
+            return ring_flash_attention(q, k, v, seq_axis, dtype)
+
+        return attn
+    return partial(ring_attention, axis=seq_axis, q_pos=pos, kv_pos=pos)
+
+
 def make_sp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
@@ -271,19 +344,7 @@ def make_sp_loss(
         offset = lax.axis_index(seq_axis) * Ll
         pos = offset + jnp.arange(Ll)
 
-        if mode == "ulysses":
-            def attn(q, k, v, dtype):
-                return ulysses_attention(
-                    q, k, v, seq_axis, dtype, use_flash=cfg.use_flash
-                )
-        elif cfg.use_flash:
-            # flash local step + lse merge: O(Ll·d) per-shard attention
-            def attn(q, k, v, dtype):
-                return ring_flash_attention(q, k, v, seq_axis, dtype)
-        else:
-            attn = partial(
-                ring_attention, axis=seq_axis, q_pos=pos, kv_pos=pos
-            )
+        attn = make_sp_attn_fn(cfg, seq_axis, mode, pos)
         x = llama.embed(vparams, tokens, cfg)
         x = llama.apply_blocks(
             vparams["blocks"], x, cfg,
@@ -296,23 +357,7 @@ def make_sp_loss(
         else:
             moe_aux = jnp.float32(0.0)
         logits = llama.unembed(vparams, x, cfg)  # [B, Ll, V] fp32
-
-        # boundary target: next shard's first token (one-token ppermute)
-        nxt = lax.ppermute(
-            tokens[:, :1], seq_axis, [((i + 1) % n, i) for i in range(n)]
-        )
-        targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        picked = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-        # the final shard's last position has no target (wrapped token):
-        # mask it, matching the serial loss over L-1 positions
-        is_last_shard = lax.axis_index(seq_axis) == n - 1
-        valid = jnp.where(
-            is_last_shard & (jnp.arange(Ll) == Ll - 1), 0.0, 1.0
-        )[None, :]
-        local_sum = -(picked * valid).sum()
-        local_cnt = (valid * jnp.ones((B, 1))).sum()
-        total = lax.psum(local_sum, seq_axis) / lax.psum(local_cnt, seq_axis)
+        total = sp_causal_lm_loss(logits, tokens, seq_axis)
         if cfg.n_experts > 0:
             total = total + jnp.float32(cfg.moe_aux_weight) * lax.pmean(
                 moe_aux, seq_axis

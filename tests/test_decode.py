@@ -181,3 +181,61 @@ def test_generate_with_top_k_p_jits(params_and_prompt):
     )(params, prompt))
     assert out.shape == (2, 5)
     assert out.min() >= 0 and out.max() < CFG.vocab_size
+
+
+# ---------------------------------------------------------------- TP decode
+
+
+@pytest.mark.parametrize("shard_vocab", [True, False])
+def test_tp_generate_equals_single_device(shard_vocab, devices8):
+    """TP-sharded generation (round-5 serving closure): head-sharded
+    attention + KV cache, row-parallel psums, and (with shard_vocab) the
+    vocab-sharded embed/unembed with one logits all_gather — greedy
+    output must equal the single-device generate token for token."""
+    from ddl25spring_tpu.models.decode import make_tp_generate
+    from ddl25spring_tpu.parallel.tp import shard_tp_params
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=4, n_layers=2, ctx_size=32,
+        dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 1, 64)
+    ref = np.asarray(generate(params, prompt, cfg, 8))
+
+    mesh = make_mesh(devices8[:2], model=2)
+    gen = make_tp_generate(cfg, mesh, 8, shard_vocab=shard_vocab)
+    got = np.asarray(gen(
+        shard_tp_params(params, mesh, shard_vocab=shard_vocab),
+        prompt, jax.random.PRNGKey(0),
+    ))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tp_generate_moe_and_sampled(devices8):
+    """TP decode with switch-MoE blocks (global routing, expert slices,
+    psum-completed combine) and a sampled (non-greedy) chain: every shard
+    draws the identical stream, so TP output == single-device output
+    under the same key."""
+    from ddl25spring_tpu.models.decode import make_tp_generate
+    from ddl25spring_tpu.parallel.tp import shard_tp_params
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=4, n_layers=2, ctx_size=32,
+        dtype="float32", n_experts=4, capacity_factor=4.0,
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(2), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 1, 64)
+    key = jax.random.PRNGKey(7)
+    ref = np.asarray(generate(
+        params, prompt, cfg, 6, temperature=0.8, top_k=8, key=key
+    ))
+
+    mesh = make_mesh(devices8[:2], model=2)
+    gen = make_tp_generate(
+        cfg, mesh, 6, temperature=0.8, top_k=8
+    )
+    got = np.asarray(gen(shard_tp_params(params, mesh), prompt, key))
+    np.testing.assert_array_equal(got, ref)

@@ -161,3 +161,77 @@ def test_tinystories_skip_disjoint_and_oversized_skip():
     assert not np.array_equal(a, b)
     huge = next(iter(TinyStories(tok, **kw, skip=10**9)))
     assert huge.shape == (2, 64)
+
+# ------------------------------------------------- SentencePiece (in-tree)
+
+
+def test_sp_model_wire_roundtrip(tmp_path):
+    """The hand-rolled ModelProto writer/reader are exact inverses —
+    the compatibility contract with real SentencePiece artifacts."""
+    from ddl25spring_tpu.data.sp_model import (
+        CONTROL, NORMAL, UNKNOWN, read_sp_model, write_sp_model,
+    )
+
+    pieces = [
+        ("<pad>", 0.0, CONTROL), ("<s>", 0.0, CONTROL),
+        ("</s>", 0.0, CONTROL), ("<unk>", 0.0, UNKNOWN),
+        ("▁the", -1.5, NORMAL), ("▁", -2.25, NORMAL), ("e", -3.0, NORMAL),
+    ]
+    p = tmp_path / "t.model"
+    write_sp_model(pieces, p)
+    got = read_sp_model(p)
+    assert [(a, c) for a, _, c in got] == [(a, c) for a, _, c in pieces]
+    for (_, s1, _), (_, s2, _) in zip(pieces, got):
+        assert abs(s1 - s2) < 1e-6
+
+
+def test_sp_tokenizer_runs_on_in_tree_artifact():
+    """The SentencePiece wrapper is live on this image (round-5 closure):
+    without the sentencepiece package it loads the committed
+    ``data/tinystories.model`` through the pure-Python unigram-Viterbi
+    processor — encode compresses vs bytes and decode round-trips."""
+    from ddl25spring_tpu.data.tokenizer import SentencePieceTokenizer
+
+    tok = SentencePieceTokenizer("data/tinystories.model")
+    assert tok.vocab_size == 512
+    text = "One day Zoe went to the school. The mouse came to play."
+    ids = tok.encode(text, add_bos=True)
+    assert ids[0] == tok.bos_id
+    body = ids[1:]
+    # trained subwords must beat byte-level length
+    assert len(body) < len(text.encode()) // 2
+    assert tok.decode(body) == text
+
+
+def test_sp_tokenizer_via_env_discovery(monkeypatch):
+    from ddl25spring_tpu.data.tokenizer import (
+        SentencePieceTokenizer, get_tokenizer,
+    )
+
+    monkeypatch.setenv("DDL25_SP_MODEL", "data/tinystories.model")
+    tok = get_tokenizer()
+    assert isinstance(tok, SentencePieceTokenizer)
+    assert tok.encode("the cat", add_bos=False)
+
+
+def test_sp_viterbi_prefers_trained_pieces_and_handles_unknowns():
+    from ddl25spring_tpu.data.sp_model import (
+        CONTROL, NORMAL, UNKNOWN, PySentencePieceProcessor, write_sp_model,
+    )
+    import tempfile, os
+
+    pieces = [
+        ("<pad>", 0.0, CONTROL), ("<s>", 0.0, CONTROL),
+        ("</s>", 0.0, CONTROL), ("<unk>", 0.0, UNKNOWN),
+        ("▁ab", -1.0, NORMAL), ("▁a", -2.0, NORMAL), ("b", -2.0, NORMAL),
+        ("▁", -3.0, NORMAL), ("a", -3.0, NORMAL),
+    ]
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.model")
+        write_sp_model(pieces, p)
+        sp = PySentencePieceProcessor(p)
+    # one merged piece (score -1) beats ▁a + b (-4): Viterbi max-sum
+    assert sp.encode("ab") == [4]
+    # an uncovered character falls back to <unk>, neighbors unaffected
+    ids = sp.encode("aXb")
+    assert sp._unk in ids and ids[0] == 5  # ▁a, <unk>, b

@@ -25,7 +25,22 @@ def main(argv=None):
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 8, 64])
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--tp", type=int, default=0, metavar="T",
+                    help="TP-sharded decode over a (model=T) mesh "
+                         "(head-sharded KV cache, vocab-sharded "
+                         "embed/unembed; needs T devices — use "
+                         "--force-cpu-devices via --cpu + "
+                         "XLA_FLAGS for local smoke)")
     args = ap.parse_args(argv)
+
+    import os
+
+    if args.tp and args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={args.tp}"
+            ).strip()
 
     import jax
 
@@ -35,7 +50,7 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from ddl25spring_tpu.models import llama
-    from ddl25spring_tpu.models.decode import generate
+    from ddl25spring_tpu.models.decode import generate, make_tp_generate
     from ddl25spring_tpu.utils.config import LlamaConfig
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -46,11 +61,22 @@ def main(argv=None):
     )
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
     print(f"device={jax.devices()[0].device_kind}  dmodel={cfg.dmodel} "
-          f"L{cfg.n_layers}  prompt={args.prompt}  new={args.new}")
+          f"L{cfg.n_layers}  prompt={args.prompt}  new={args.new}"
+          + (f"  tp={args.tp}" if args.tp else ""))
 
-    gen = jax.jit(
-        lambda p, prompt: generate(p, prompt, cfg, args.new),
-    )
+    if args.tp:
+        from ddl25spring_tpu.parallel.tp import shard_tp_params
+        from ddl25spring_tpu.utils.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices()[: args.tp], model=args.tp)
+        params = shard_tp_params(params, mesh)
+        tp_gen = make_tp_generate(cfg, mesh, args.new)
+        key0 = jax.random.PRNGKey(0)
+        gen = lambda p, prompt: tp_gen(p, prompt, key0)
+    else:
+        gen = jax.jit(
+            lambda p, prompt: generate(p, prompt, cfg, args.new),
+        )
     for B in args.batches:
         prompt = jax.random.randint(
             jax.random.PRNGKey(1), (B, args.prompt), 0, cfg.vocab_size

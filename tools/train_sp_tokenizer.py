@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Regenerate the committed ``data/tinystories.model`` SentencePiece
+artifact from the synthetic TinyStories corpus.
+
+The artifact is what keeps the SentencePiece path live on images without
+the sentencepiece package (``data/sp_model.py``); it is committed so CI
+exercises the wrapper.  Re-run this only when the corpus generator or the
+trainer changes: ``python tools/train_sp_tokenizer.py [--vocab 512]``.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--stories", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="data/tinystories.model")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from ddl25spring_tpu.data.sp_model import (
+        PySentencePieceProcessor, train_sp_model,
+    )
+    from ddl25spring_tpu.data.tinystories import generate_story
+
+    rng = np.random.default_rng(args.seed)
+    texts = [generate_story(rng) for _ in range(args.stories)]
+    train_sp_model(texts, vocab_size=args.vocab, path=args.out)
+    sp = PySentencePieceProcessor(args.out)
+    sample = texts[0][:60]
+    ids = sp.encode(sample)
+    print(f"{args.out}: vocab={sp.vocab_size()}, "
+          f"{Path(args.out).stat().st_size} bytes; "
+          f"'{sample}' -> {len(ids)} tokens "
+          f"(bytes: {len(sample.encode())})")
+    assert sp.decode(ids) == sample
+
+
+if __name__ == "__main__":
+    main()
