@@ -219,14 +219,19 @@ class PySentencePieceProcessor:
         return ids[::-1]
 
     def decode(self, ids) -> str:
-        CONTROL_T = (CONTROL, UNKNOWN)
-        text = "".join(
-            self.pieces[int(i)][0]
-            for i in ids
-            if 0 <= int(i) < len(self.pieces)
-            and self.pieces[int(i)][2] not in CONTROL_T
-        )
-        return text.replace(_WS, " ").lstrip(" ")
+        # real SentencePiece skips CONTROL pieces but renders UNKNOWN as
+        # " ⁇ " — silent dropping would lose characters on out-of-vocab
+        # input, breaking parity exactly where it matters
+        parts = []
+        for i in ids:
+            i = int(i)
+            if not 0 <= i < len(self.pieces):
+                continue
+            piece, _, ptype = self.pieces[i]
+            if ptype == CONTROL:
+                continue
+            parts.append(" ⁇ " if ptype == UNKNOWN else piece)
+        return "".join(parts).replace(_WS, " ").lstrip(" ")
 
 
 # ------------------------------------------------------------ training

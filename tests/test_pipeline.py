@@ -529,7 +529,7 @@ def test_grad_accum_equals_full_batch():
     )
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "interleaved"])
+@pytest.mark.parametrize("schedule", ["gpipe", "interleaved", "interleaved-1f1b"])
 def test_fused_steps_equal_sequential(schedule, devices8):
     """fuse_train_steps(step, K) on [K, B, L] stacked batches must land on
     the same params/losses as K sequential dispatches of the same step
@@ -540,16 +540,17 @@ def test_fused_steps_equal_sequential(schedule, devices8):
     S, M, K = 2, 2, 3
     mesh = make_mesh(devices8[:S], stage=S)
     params = llama.init_llama_params(jax.random.PRNGKey(5), CFG)
-    if schedule == "interleaved":
+    chunked = schedule.startswith("interleaved")
+    if chunked:
         staged = llama.split_blocks_interleaved(params, S, 2)
     else:
         staged = llama.split_blocks_for_stages(params, S)
     tx = optax.sgd(0.05)
-    # num_chunks only rides the interleaved schedule — passing it with
+    # num_chunks only rides the interleaved schedules — passing it with
     # gpipe now raises (the round-4 advisor's silent-fallback finding)
     step = make_pipeline_train_step(
         CFG, tx, mesh, M, schedule=schedule,
-        num_chunks=2 if schedule == "interleaved" else 1,
+        num_chunks=2 if chunked else 1,
     )
     tokens_k = jax.random.randint(jax.random.PRNGKey(6), (K, 4, 16), 0, 64)
 

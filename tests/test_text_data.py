@@ -235,3 +235,6 @@ def test_sp_viterbi_prefers_trained_pieces_and_handles_unknowns():
     # an uncovered character falls back to <unk>, neighbors unaffected
     ids = sp.encode("aXb")
     assert sp._unk in ids and ids[0] == 5  # ▁a, <unk>, b
+    # decode renders <unk> as " ⁇ " like real SentencePiece (silently
+    # dropping it would lose characters on out-of-vocab input)
+    assert sp.decode(ids) == "a ⁇ b"
