@@ -79,22 +79,17 @@ def staged_param_specs(
     fail with an opaque tree-map KeyError."""
     if ep_axis is not None and tp_axis is not None:
         raise NotImplementedError("ep_axis and tp_axis are exclusive")
-    if ep_axis is not None and chunked:
-        # the EP specs below index the 4-d [S, Lc, E, ...] expert stacks;
-        # padding them onto 5-d interleaved stacks would silently shard
-        # the layer dim over the expert axis
-        raise NotImplementedError(
-            "EP expert sharding is not wired for the interleaved "
-            "(chunked) block layout"
-        )
     blocks: Any = P(stage_axis)
     if ep_axis is not None:
+        # expert stacks: [S, (V,) Lc, E, ...] — the expert dim sits one
+        # deeper under the interleaved chunk layout
+        pad = (None,) * (2 if chunked else 1)
         blocks = {k: P(stage_axis) for k in llama.ATTN_BLOCK_KEYS}
         blocks["moe"] = {
             "router": P(stage_axis),
-            "w_gate": P(stage_axis, None, ep_axis),
-            "w_up": P(stage_axis, None, ep_axis),
-            "w_down": P(stage_axis, None, ep_axis),
+            "w_gate": P(stage_axis, *pad, ep_axis),
+            "w_up": P(stage_axis, *pad, ep_axis),
+            "w_down": P(stage_axis, *pad, ep_axis),
         }
     elif tp_axis is not None:
         # single source of which weights are column- vs row-parallel:
@@ -279,9 +274,11 @@ def make_pipeline_loss(
     sequence-sharded causal loss (one boundary-token ppermute + psum
     pair — :func:`~ddl25spring_tpu.parallel.sp.sp_causal_lm_loss`).
     Activations crossing stage boundaries stay sequence-sharded, so the
-    per-device boundary traffic ALSO falls by ``n``.  Dense blocks,
-    plain schedule only (``n_experts``/``ep_axis``/``tp_axis``/
-    ``num_chunks`` compositions with SP are guarded off).
+    per-device boundary traffic ALSO falls by ``n``.  Composes with
+    ``tp_axis`` (PP x SP x TP: the attention fns operate on the local
+    head subset the TP column slices produce).  Dense blocks, plain
+    schedule only (``n_experts``/``ep_axis``/``num_chunks``
+    compositions with SP are guarded off).
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
@@ -293,11 +290,6 @@ def make_pipeline_loss(
                 "SP inside the pipeline ships dense blocks; the sharded "
                 "MoE aux estimator under a seq axis is not wired"
             )
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "seq_axis and tp_axis inside the same pipeline stage is "
-                "not wired (head-sharded ring attention untested)"
-            )
         if V > 1:
             raise NotImplementedError(
                 "seq_axis rides the plain (num_chunks=1) gpipe schedule"
@@ -305,17 +297,17 @@ def make_pipeline_loss(
         if sp_mode not in ("ring", "ulysses"):
             raise ValueError(f"unknown SP mode {sp_mode!r}")
         n_seq = mesh.shape[seq_axis]
-        if sp_mode == "ulysses" and cfg.num_heads % n_seq:
+        # under TP the per-device head count is already H/t; ulysses'
+        # second shard dim must divide what is left
+        local_heads = cfg.num_heads // (
+            mesh.shape[tp_axis] if tp_axis is not None else 1
+        )
+        if sp_mode == "ulysses" and local_heads % n_seq:
             raise ValueError(
-                f"ulysses SP needs num_heads ({cfg.num_heads}) divisible "
+                f"ulysses SP needs local heads ({local_heads}) divisible "
                 f"by the {seq_axis!r} axis size ({n_seq})"
             )
     if V > 1:
-        if ep_axis is not None:
-            raise NotImplementedError(
-                "EP expert sharding rides the plain (num_chunks=1) "
-                "gpipe schedule only"
-            )
         if M % S:
             raise ValueError(
                 f"interleaved schedule needs microbatches ({M}) divisible "
@@ -522,6 +514,7 @@ def make_interleaved_pipeline_loss(
     data_axis: str | None = None,
     remat: bool = False,
     tp_axis: str | None = None,
+    ep_axis: str | None = None,
 ):
     """Interleaved virtual-stage pipeline (Megatron-LM-style chunking).
 
@@ -564,7 +557,7 @@ def make_interleaved_pipeline_loss(
     """
     return make_pipeline_loss(
         cfg, mesh, num_microbatches, stage_axis, data_axis, remat,
-        num_chunks=num_chunks, tp_axis=tp_axis,
+        num_chunks=num_chunks, tp_axis=tp_axis, ep_axis=ep_axis,
     )
 
 
@@ -669,7 +662,8 @@ def make_1f1b_value_and_grad(
     bubble win of interleaving composed with the bounded memory of 1F1B.
     ``V = 1`` reduces every formula to the plain schedule above (this is
     the single implementation of both).  ``stash`` must be ``"input"``
-    and ``ep_axis`` ``None`` under ``num_chunks > 1``.
+    under ``num_chunks > 1``; ``ep_axis`` composes (the EP branch runs
+    the chunk unconditionally with a masked output, as at V = 1).
     """
     if stash not in ("input", "residuals"):
         raise ValueError(f"stash must be 'input' or 'residuals', got {stash!r}")
@@ -684,11 +678,6 @@ def make_1f1b_value_and_grad(
             raise NotImplementedError(
                 "interleaved 1F1B ships the input-stash (remat) backward; "
                 "residual rings are not wired for chunked stacks"
-            )
-        if ep_axis is not None:
-            raise NotImplementedError(
-                "EP expert sharding is not wired for the interleaved "
-                "(chunked) block layout"
             )
         if M % S:
             raise ValueError(
@@ -854,13 +843,14 @@ def make_1f1b_value_and_grad(
             # control flow — run it unconditionally and mask the output
             # instead (drain ticks pay one dead stage forward)
             run_fwd = jnp.logical_and(fwd_active, jnp.logical_not(finish_f))
-            chunk_f = chunk_slice(local_blocks, v_f)
             if ep_axis is not None:
                 x_body = llama.apply_blocks(
-                    vblocks, x_in, cfg, tp_axis=tp_axis, moe_fn=moe_fn
+                    chunk_slice(vblocks, v_f), x_in, cfg, tp_axis=tp_axis,
+                    moe_fn=moe_fn,
                 )
                 x_out = jnp.where(run_fwd, x_body, x_in)
             else:
+                chunk_f = chunk_slice(local_blocks, v_f)
                 x_out = lax.cond(
                     run_fwd,
                     lambda x: llama.apply_blocks(
@@ -1176,10 +1166,11 @@ def make_pipeline_train_step(
     ``split_blocks_interleaved``).
 
     ``ep_axis``: shard the MoE expert stacks over the data axis too
-    (EP x DP x PP — see :func:`make_pipeline_loss` for gpipe and
-    :func:`make_1f1b_value_and_grad` for the 1F1B schedules; the
-    interleaved schedule still keeps experts replicated); pass params
-    through ``shard_staged_params(..., ep_axis=...)``.
+    (EP x DP x PP) — on EVERY schedule: gpipe and interleaved (see
+    :func:`make_pipeline_loss`), both 1F1B stashes and interleaved-1F1B
+    (see :func:`make_1f1b_value_and_grad`).  Pass params through
+    ``shard_staged_params(..., ep_axis=...)`` (``chunked=True`` for the
+    interleaved 5-d expert stacks).
 
     ``tp_axis``: Megatron TP inside each stage (DP x PP x TP) on EVERY
     schedule; pass params through ``shard_staged_params(..., tp_axis=...)``
@@ -1204,13 +1195,9 @@ def make_pipeline_train_step(
             f"'interleaved-1f1b' (got {schedule!r})"
         )
     if schedule == "interleaved":
-        if ep_axis is not None:
-            raise NotImplementedError(
-                "EP expert sharding rides the gpipe schedule only"
-            )
         loss_fn = make_interleaved_pipeline_loss(
             cfg, mesh, num_microbatches, num_chunks, stage_axis, data_axis,
-            tp_axis=tp_axis,
+            tp_axis=tp_axis, ep_axis=ep_axis,
         )
         vag = jax.value_and_grad(loss_fn)
     elif schedule == "interleaved-1f1b":

@@ -423,9 +423,8 @@ def test_ep_dp_pp_expert_sharded_equals_dense(cf, devices8):
 
 
 def test_ep_pipeline_train_step_and_guards(devices8):
-    """The EP x DP x PP train step runs (loss falls over steps) and the
-    interleaved schedule still refuses ep_axis (the chunked 5-d expert
-    stacks are not wired for EP sharding)."""
+    """The EP x DP x PP train step runs (loss falls over steps); EP and
+    TP remain mutually exclusive in the staged specs."""
     S, M = 2, 2
     mesh = make_mesh(devices8[:4], data=2, stage=S)
     params = llama.init_llama_params(jax.random.PRNGKey(0), MOE_CFG)
@@ -444,11 +443,56 @@ def test_ep_pipeline_train_step_and_guards(devices8):
         losses.append(float(loss))
     assert losses[-1] < losses[0]
 
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(NotImplementedError, match="exclusive"):
         make_pipeline_train_step(
-            MOE_CFG, tx, mesh, M, data_axis="data", schedule="interleaved",
-            num_chunks=2, ep_axis="data",
+            MOE_CFG, tx, mesh, M, data_axis="data", ep_axis="data",
+            tp_axis="data",
         )
+
+
+@pytest.mark.parametrize("schedule", ["interleaved", "interleaved-1f1b"])
+def test_ep_interleaved_expert_sharded_equals_dense(schedule, devices8):
+    """EP rides BOTH interleaved schedules (round-5 closure of the
+    chunked-EP guard): the 5-d expert stacks shard their expert dim over
+    the data axis, the per-tick a2a sits in uniform control flow (the
+    interleaved tick runs its chunk unconditionally under EP), and loss
+    + grads equal the dense replicated-expert run exactly — heavy drops
+    included."""
+    import dataclasses
+
+    cfg = dataclasses.replace(MOE_CFG, capacity_factor=0.5)
+    S, V, M, dp = 2, 2, 2, 2
+    mesh = make_mesh(devices8[:4], data=dp, stage=S)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    staged = llama.split_blocks_interleaved(params, S, V)
+
+    if schedule == "interleaved":
+        def vag(ep_axis, p):
+            return jax.jit(jax.value_and_grad(make_interleaved_pipeline_loss(
+                cfg, mesh, M, V, data_axis="data", ep_axis=ep_axis
+            )))(p, tokens)
+    else:
+        def vag(ep_axis, p):
+            return jax.jit(make_1f1b_value_and_grad(
+                cfg, mesh, M, data_axis="data", num_chunks=V,
+                ep_axis=ep_axis,
+            ))(p, tokens)
+
+    l_dense, g_dense = vag(None, staged)
+    sharded = shard_staged_params(staged, mesh, ep_axis="data", chunked=True)
+    w = sharded["blocks"]["moe"]["w_gate"]
+    assert w.addressable_shards[0].data.shape[3] == cfg.n_experts // dp
+    l_ep, g_ep = vag("data", sharded)
+
+    np.testing.assert_allclose(float(l_ep), float(l_dense), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-5, rtol=2e-4
+        ),
+        g_dense,
+        g_ep,
+    )
 
 
 @pytest.mark.parametrize("cf,stash", [
@@ -1165,7 +1209,38 @@ def test_pipeline_sp_train_step_and_guards(devices8):
         make_pipeline_train_step(
             CFG, tx, mesh, M, seq_axis="seq", schedule="1f1b"
         )
-    with pytest.raises(NotImplementedError, match="tp_axis"):
-        make_pipeline_loss(CFG, mesh, M, seq_axis="seq", tp_axis="model")
     with pytest.raises(NotImplementedError, match="dense"):
         make_pipeline_loss(MOE_CFG, mesh, M, seq_axis="seq")
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_pipeline_sp_tp_equals_serial(mode, devices8):
+    """The full PP x SP x TP composition on a (stage, seq, model) mesh:
+    Megatron-split matmuls operate on the per-shard head subset, ring /
+    Ulysses attention runs over the seq axis within each stage, and loss
+    + grads equal the serial model."""
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=32, num_heads=4, n_layers=4, ctx_size=16,
+        dtype="float32",
+    )
+    S, sq, T, M = 2, 2, 2, 2
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+
+    def serial(p):
+        return causal_lm_loss(llama.llama_forward(p, tokens, cfg), tokens)
+
+    mesh = make_mesh(devices8[:8], stage=S, seq=sq, model=T)
+    staged = llama.split_blocks_for_stages(params, S)
+    loss = make_pipeline_loss(
+        cfg, mesh, M, seq_axis="seq", sp_mode=mode, tp_axis="model"
+    )
+    l, g = jax.jit(jax.value_and_grad(loss))(staged, tokens)
+    np.testing.assert_allclose(float(l), float(serial(params)), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        jax.grad(serial)(params),
+        llama.merge_blocks_from_stages(g),
+    )

@@ -15,7 +15,6 @@ scripts directly) to reproduce RESULTS.md §1-§2.
 Regenerate: ``python tools/make_notebooks.py``.
 """
 
-import sys
 from pathlib import Path
 
 import nbformat as nbf
