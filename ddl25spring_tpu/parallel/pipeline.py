@@ -187,6 +187,48 @@ def _tp_moe_fn(cfg: LlamaConfig, tp_axis: str):
     return make_tp_moe_fn(tp_axis, cfg.capacity_factor, cfg.moe_top_k)
 
 
+def _check_sp(cfg, mesh, seq_axis, sp_mode, tp_axis):
+    """Shared SP preconditions for the pipeline schedules.  The ulysses
+    head check accounts for TP: the per-device head count is already
+    ``H/t`` before the seq all_to_all splits it further."""
+    if sp_mode not in ("ring", "ulysses"):
+        raise ValueError(f"unknown SP mode {sp_mode!r}")
+    n_seq = mesh.shape[seq_axis]
+    local_heads = cfg.num_heads // (
+        mesh.shape[tp_axis] if tp_axis is not None else 1
+    )
+    if sp_mode == "ulysses" and local_heads % n_seq:
+        raise ValueError(
+            f"ulysses SP needs local heads ({local_heads}) divisible "
+            f"by the {seq_axis!r} axis size ({n_seq})"
+        )
+
+
+def _sp_block_kw(cfg, seq_axis, sp_mode, L, tokens_mb):
+    """The per-trace SP setup shared by the GPipe and 1F1B schedules
+    (called INSIDE their shard_maps): global RoPE positions + the SP
+    attention fn for every block, and the causal targets from ONE
+    pre-scan boundary ppermute — so the per-tick loss stays
+    collective-free (a collective inside the stage-varying finish cond
+    deadlocks the matcher).  Returns ``(block_kw, targets_mb,
+    valid_row)``; with ``seq_axis=None`` the no-SP identity
+    ``({}, tokens_mb, None)``, so call sites need no branch."""
+    if seq_axis is None:
+        return {}, tokens_mb, None
+    from ddl25spring_tpu.parallel.sp import (
+        make_sp_attn_fn, sp_shifted_targets,
+    )
+
+    pos = lax.axis_index(seq_axis) * L + jnp.arange(L)
+    sp_attn = make_sp_attn_fn(cfg, seq_axis, sp_mode, pos)
+    block_kw = {
+        "pos": pos,
+        "attn_fn": lambda q, k, v, dtype: sp_attn(q, k, v, dtype=dtype),
+    }
+    targets_mb, valid_row = sp_shifted_targets(tokens_mb, seq_axis)
+    return block_kw, targets_mb, valid_row
+
+
 def _slot_map(k, V: int, S: int, M: int):
     """Megatron's interleaved slot grouping — THE single source of the
     schedule: slot ``k`` maps to chunk ``v`` and microbatch ``m`` by
@@ -294,19 +336,7 @@ def make_pipeline_loss(
             raise NotImplementedError(
                 "seq_axis rides the plain (num_chunks=1) gpipe schedule"
             )
-        if sp_mode not in ("ring", "ulysses"):
-            raise ValueError(f"unknown SP mode {sp_mode!r}")
-        n_seq = mesh.shape[seq_axis]
-        # under TP the per-device head count is already H/t; ulysses'
-        # second shard dim must divide what is left
-        local_heads = cfg.num_heads // (
-            mesh.shape[tp_axis] if tp_axis is not None else 1
-        )
-        if sp_mode == "ulysses" and local_heads % n_seq:
-            raise ValueError(
-                f"ulysses SP needs local heads ({local_heads}) divisible "
-                f"by the {seq_axis!r} axis size ({n_seq})"
-            )
+        _check_sp(cfg, mesh, seq_axis, sp_mode, tp_axis)
     if V > 1:
         if M % S:
             raise ValueError(
@@ -350,31 +380,11 @@ def make_pipeline_loss(
             + ((seq_axis,) if seq_axis else ())
         )
 
-        if seq_axis is not None:
-            from ddl25spring_tpu.parallel.sp import make_sp_attn_fn
-
-            # L above is the LOCAL shard length; attention needs global
-            # RoPE positions and the SP attention implementation
-            pos = lax.axis_index(seq_axis) * L + jnp.arange(L)
-            sp_attn = make_sp_attn_fn(cfg, seq_axis, sp_mode, pos)
-            block_kw = {
-                "pos": pos,
-                "attn_fn": lambda q, k, v, dtype: sp_attn(q, k, v, dtype=dtype),
-            }
-            # Sequence-sharded causal targets, computed BEFORE the scan:
-            # the boundary token (next shard's first) comes from ONE
-            # ppermute over the whole [M, mb, 1] token slab — tokens are
-            # static, so no per-tick collective is needed, and the loss
-            # inside the finish cond stays purely local.  Collectives
-            # inside that cond would execute on last-stage devices only:
-            # a collective sequence that differs across the stage axis
-            # deadlocks the matching engine (observed on the CPU mesh).
-            from ddl25spring_tpu.parallel.sp import sp_shifted_targets
-
-            targets_mb, valid_row = sp_shifted_targets(tokens_mb, seq_axis)
-        else:
-            block_kw = {}
-            targets_mb = tokens_mb
+        # L is the LOCAL shard length; see _sp_block_kw for why the
+        # targets precompute keeps the tick collective-free
+        block_kw, targets_mb, valid_row = _sp_block_kw(
+            cfg, seq_axis, sp_mode, L, tokens_mb
+        )
 
         # Varying copies of the embed/unembed params, cast OUTSIDE the scan:
         # their cotangent psum (the transpose of this pcast) then executes
@@ -571,6 +581,8 @@ def make_1f1b_value_and_grad(
     tp_axis: str | None = None,
     ep_axis: str | None = None,
     num_chunks: int = 1,
+    seq_axis: str | None = None,
+    sp_mode: str = "ring",
 ):
     """1F1B: the memory-bounded pipeline schedule, hand-rolled backward.
 
@@ -673,6 +685,26 @@ def make_1f1b_value_and_grad(
     dtype = jnp.dtype(cfg.dtype)
     K = 2 * V * S - 1  # ring slots; slot K is scratch for inactive ticks
     DELTA = V * S - 1  # backward-stream delay (== S-1 at V == 1)
+    if seq_axis is not None:
+        # SP under the hand-rolled 1F1B: same design as the GPipe path
+        # (pre-scan boundary targets, collective-free per-tick loss sums,
+        # unconditional-masked forward slot so the ring/a2a collectives
+        # stay uniform), plus psum-over-seq grad assembly at the end
+        if cfg.n_experts > 0 or ep_axis is not None:
+            raise NotImplementedError(
+                "SP under 1F1B ships dense blocks (no MoE/EP composition)"
+            )
+        if tp_axis is not None:
+            raise NotImplementedError(
+                "seq_axis with tp_axis under the hand-rolled 1F1B "
+                "backward is not wired (use the gpipe schedule for "
+                "PP x SP x TP)"
+            )
+        if stash != "input":
+            raise NotImplementedError(
+                "SP under 1F1B rides the remat (stash='input') backward"
+            )
+        _check_sp(cfg, mesh, seq_axis, sp_mode, tp_axis)
     if V > 1:
         if stash != "input":
             raise NotImplementedError(
@@ -687,7 +719,7 @@ def make_1f1b_value_and_grad(
     if tp_axis is not None:
         _check_tp(cfg, mesh, tp_axis)
 
-    tok_spec = P(None, data_axis)
+    tok_spec = P(None, data_axis, seq_axis)
     # one spec tree serves both sides: param grads come back in the same
     # layout the params go in
     param_specs = staged_param_specs(
@@ -718,6 +750,7 @@ def make_1f1b_value_and_grad(
             (stage_axis,)
             + ((data_axis,) if data_axis else ())
             + ((tp_axis,) if tp_axis else ())
+            + ((seq_axis,) if seq_axis else ())
         )
 
         head = lax.pcast(
@@ -726,29 +759,45 @@ def make_1f1b_value_and_grad(
             to="varying",
         )
         # blocks are varying over stage (and tp, when sharded) already;
-        # only the data axis needs the explicit pcast
-        if data_axis and ep_axis:
+        # the data and seq axes need the explicit pcast — per-shard
+        # "copies" whose grads the final assembly combines explicitly
+        # (an invariant weight would instead get an implicit cotangent
+        # psum inside EVERY tick's vjp: one hidden collective per tick,
+        # and double-counting under the explicit assembly)
+        vary = ((data_axis,) if data_axis else ()) + (
+            (seq_axis,) if seq_axis else ()
+        )
+        if vary and ep_axis:
             # the expert stacks arrive SHARDED (hence varying) over the
-            # data axis; pcast only the data-invariant leaves
+            # data axis; pcast only the data-invariant leaves (ep and
+            # seq are mutually exclusive, so vary == (data_axis,))
             vblocks = {
-                k: lax.pcast(v, (data_axis,), to="varying")
+                k: lax.pcast(v, vary, to="varying")
                 for k, v in local_blocks.items() if k != "moe"
             }
             vblocks["moe"] = dict(
                 local_blocks["moe"],
                 router=lax.pcast(
-                    local_blocks["moe"]["router"], (data_axis,), to="varying"
+                    local_blocks["moe"]["router"], vary, to="varying"
                 ),
             )
-        elif data_axis:
-            vblocks = lax.pcast(local_blocks, (data_axis,), to="varying")
+        elif vary:
+            vblocks = lax.pcast(local_blocks, vary, to="varying")
         else:
             vblocks = local_blocks
 
         is_last = s == S - 1
 
+        # same design as the GPipe seq path (shared _sp_block_kw)
+        block_kw, targets_mb, valid_row = _sp_block_kw(
+            cfg, seq_axis, sp_mode, L, tokens_mb
+        )
+        if seq_axis is not None:
+            from ddl25spring_tpu.parallel.sp import sp_local_ce_sum
+
         def local_fwd_loss(
-            blocks, hd, x_in, tok, inject=None, finish=None, embed_in=True
+            blocks, hd, x_in, tok, inject=None, finish=None, embed_in=True,
+            tgt=None,
         ):
             """This (virtual) stage's slice of the model, as one
             differentiable fn: the injecting slot prepends embed
@@ -756,11 +805,14 @@ def make_1f1b_value_and_grad(
             MoE stages add their layers' weighted aux loss.  ``inject`` /
             ``finish`` default to the plain-1F1B flags (first / last
             device); the interleaved schedule passes its slot-dependent
-            flags.  The residual-stash path passes ``embed_in=False`` and
-            handles the embed outside — see the closure_convert note
-            there."""
+            flags.  ``tgt`` (defaults to ``tok``) carries the loss
+            targets when they differ from the embed tokens — the SP path,
+            whose targets are the pre-shifted boundary-ppermute output.
+            The residual-stash path passes ``embed_in=False`` and handles
+            the embed outside — see the closure_convert note there."""
             inject = (s == 0) if inject is None else inject
             finish = is_last if finish is None else finish
+            tgt = tok if tgt is None else tgt
             if embed_in:
                 x_in = lax.cond(
                     inject,
@@ -775,11 +827,23 @@ def make_1f1b_value_and_grad(
                 )
                 aux_term = jnp.float32(cfg.moe_aux_weight) * aux
             else:
-                x_out = llama.apply_blocks(blocks, x_in, cfg, tp_axis=tp_axis)
+                x_out = llama.apply_blocks(
+                    blocks, x_in, cfg, tp_axis=tp_axis, **block_kw
+                )
                 aux_term = jnp.float32(0.0)
+            if seq_axis is not None:
+                # collective-free local CE SUM (psum + mean after the scan)
+                def loss_branch(x):
+                    return sp_local_ce_sum(
+                        llama.unembed(hd, x, cfg), tgt, valid_row
+                    )
+            else:
+                def loss_branch(x):
+                    return causal_lm_loss(llama.unembed(hd, x, cfg), tgt)
+
             loss = lax.cond(
                 finish,
-                lambda x: causal_lm_loss(llama.unembed(hd, x, cfg), tok),
+                loss_branch,
                 lambda x: lax.pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
             )
@@ -843,10 +907,12 @@ def make_1f1b_value_and_grad(
             # control flow — run it unconditionally and mask the output
             # instead (drain ticks pay one dead stage forward)
             run_fwd = jnp.logical_and(fwd_active, jnp.logical_not(finish_f))
-            if ep_axis is not None:
+            if ep_axis is not None or seq_axis is not None:
+                # EP's a2a / SP's ring collectives must execute in
+                # uniform control flow: run unconditionally, mask
                 x_body = llama.apply_blocks(
                     chunk_slice(vblocks, v_f), x_in, cfg, tp_axis=tp_axis,
-                    moe_fn=moe_fn,
+                    moe_fn=moe_fn, **block_kw
                 )
                 x_out = jnp.where(run_fwd, x_body, x_in)
             else:
@@ -870,11 +936,12 @@ def make_1f1b_value_and_grad(
                 jnp.clip(jnp.where(bwd_active, k_fwd_b % K, K), 0, K)
             ]
             tok_b = tokens_mb[m_b]
+            tgt_b = targets_mb[m_b]
             vchunk_b = chunk_slice(vblocks, v_b)
 
             (x_out_b, loss_b), pull = jax.vjp(
                 lambda b, h, x: local_fwd_loss(
-                    b, h, x, tok_b, inject_b, finish_b
+                    b, h, x, tok_b, inject_b, finish_b, tgt=tgt_b
                 ),
                 vchunk_b, head, x_saved,
             )
@@ -1048,9 +1115,26 @@ def make_1f1b_value_and_grad(
 
         # mean over microbatches; DP mean over the data axis (the automatic
         # cotangent psum of the GPipe path, done by hand here)
-        loss = lax.psum(loss_sum, stage_axis) / M
-        gblocks = jax.tree.map(lambda g: g[None] / M, gblocks)
-        ghead = jax.tree.map(lambda g: g / M, ghead)
+        if seq_axis is not None:
+            # the ticks banked LOCAL CE sums and every seq shard
+            # accumulated only its own compute's grad paths: one psum
+            # over seq assembles both, then the global-token-count mean
+            # replaces the /M (L here is the local shard length)
+            n_sq = lax.psum(1, seq_axis)
+            norm = M * mb * (L * n_sq - 1)
+            loss = lax.psum(
+                lax.psum(loss_sum, stage_axis), seq_axis
+            ) / norm
+            gblocks = jax.tree.map(
+                lambda g: lax.psum(g, seq_axis)[None] / norm, gblocks
+            )
+            ghead = jax.tree.map(
+                lambda g: lax.psum(g, seq_axis) / norm, ghead
+            )
+        else:
+            loss = lax.psum(loss_sum, stage_axis) / M
+            gblocks = jax.tree.map(lambda g: g[None] / M, gblocks)
+            ghead = jax.tree.map(lambda g: g / M, ghead)
         ghead = jax.tree.map(lambda g: lax.psum(g, stage_axis), ghead)
         if tp_axis is not None:
             # the uniform 1.0 seed on every TP member differentiates the
@@ -1181,10 +1265,13 @@ def make_pipeline_train_step(
     shard their length dim over the axis, ``sp_mode`` picks
     ring/ulysses attention.
     """
-    if seq_axis is not None and schedule != "gpipe":
+    if seq_axis is not None and schedule not in (
+        "gpipe", "1f1b", "interleaved-1f1b"
+    ):
         raise NotImplementedError(
-            "seq_axis rides the gpipe schedule only (the hand-rolled "
-            "1F1B backwards are not wired for sequence-sharded stages)"
+            "seq_axis rides gpipe, 1f1b, and interleaved-1f1b (the "
+            "residual-stash and scan-transpose-interleaved backwards "
+            "are not wired for sequence-sharded stages)"
         )
     if num_chunks > 1 and schedule not in ("interleaved", "interleaved-1f1b"):
         # silently falling back to plain GPipe would train a different
@@ -1206,13 +1293,14 @@ def make_pipeline_train_step(
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="input", tp_axis=tp_axis, ep_axis=ep_axis,
-            num_chunks=num_chunks,
+            num_chunks=num_chunks, seq_axis=seq_axis, sp_mode=sp_mode,
         )
     elif schedule in ("1f1b", "1f1b-stash"):
         vag = make_1f1b_value_and_grad(
             cfg, mesh, num_microbatches, stage_axis, data_axis,
             stash="residuals" if schedule == "1f1b-stash" else "input",
-            tp_axis=tp_axis, ep_axis=ep_axis,
+            tp_axis=tp_axis, ep_axis=ep_axis, seq_axis=seq_axis,
+            sp_mode=sp_mode,
         )
     elif schedule == "gpipe":
         loss_fn = make_pipeline_loss(
@@ -1311,7 +1399,7 @@ def shard_staged_params(
     stage_axis: str = "stage",
     ep_axis: str | None = None,
     tp_axis: str | None = None,
-    chunked: bool = False,
+    chunked: bool | None = None,
 ):
     """Place staged params on the mesh: blocks sharded over the stage axis,
     the rest replicated — each device holds only its stages' layers, like
@@ -1319,16 +1407,25 @@ def shard_staged_params(
     ``ep_axis``, the expert stacks additionally shard over that axis
     (each device then holds only ``E/n`` experts of its stages); with
     ``tp_axis``, block matmuls additionally column/row-shard over it
-    (DP x PP x TP).  Pass ``chunked=True`` when the params came from
-    ``split_blocks_interleaved`` (5-d ``[S, V, Lc, d, d]`` stacks) so the
-    TP specs target the matmul dims, not the extra chunk dim.  Switch-MoE
-    params are detected from the tree (the ``moe`` subtree) so the TP
-    branch emits the expert-sharded schema instead of failing on the
+    (DP x PP x TP).
+
+    ``chunked`` (params from ``split_blocks_interleaved``: 5-d
+    ``[S, V, Lc, d, d]`` stacks, so the EP/TP specs must target the
+    matmul/expert dims past the extra chunk dim) is INFERRED from the
+    tree by default — a forgotten explicit flag under ``ep_axis`` would
+    silently shard the layer dim over the expert axis.  Switch-MoE
+    params are detected from the tree too (the ``moe`` subtree) so the
+    TP branch emits the expert-sharded schema instead of failing on the
     dense key set."""
     n_experts = (
         params["blocks"]["moe"]["router"].shape[-1]
         if "moe" in params["blocks"] else 0
     )
+    if chunked is None:
+        # dense-split wq stacks are [S, Lc, d, d]; interleaved add a
+        # chunk dim -> 5-d
+        wq = params["blocks"]["wq"]
+        chunked = getattr(wq, "ndim", len(jnp.shape(wq))) == 5
     specs = staged_param_specs(
         stage_axis, ep_axis, tp_axis, chunked, n_experts=n_experts
     )
