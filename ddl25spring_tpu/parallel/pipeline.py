@@ -694,12 +694,6 @@ def make_1f1b_value_and_grad(
             raise NotImplementedError(
                 "SP under 1F1B ships dense blocks (no MoE/EP composition)"
             )
-        if tp_axis is not None:
-            raise NotImplementedError(
-                "seq_axis with tp_axis under the hand-rolled 1F1B "
-                "backward is not wired (use the gpipe schedule for "
-                "PP x SP x TP)"
-            )
         if stash != "input":
             raise NotImplementedError(
                 "SP under 1F1B rides the remat (stash='input') backward"

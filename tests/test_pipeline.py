@@ -285,6 +285,12 @@ MOE_CFG = LlamaConfig(
     dtype="float32", n_experts=4, capacity_factor=2.0,
 )
 
+# 4-head variant for TP tests (heads must divide the model axis)
+CFG4H = LlamaConfig(
+    vocab_size=64, dmodel=32, num_heads=4, n_layers=4, ctx_size=16,
+    dtype="float32",
+)
+
 
 def serial_moe_loss(params, tokens, M):
     """Per-microbatch oracle: the pipeline's MoE dispatch groups are the
@@ -1213,31 +1219,39 @@ def test_pipeline_sp_train_step_and_guards(devices8):
         make_pipeline_loss(MOE_CFG, mesh, M, seq_axis="seq")
 
 
-@pytest.mark.parametrize("mode,num_chunks", [
-    ("ring", 1), ("ulysses", 1), ("ring", 2),
+@pytest.mark.parametrize("mode,num_chunks,tp", [
+    ("ring", 1, 1), ("ulysses", 1, 1), ("ring", 2, 1),
+    ("ring", 1, 2), ("ulysses", 1, 2), ("ring", 2, 2),
 ])
-def test_sp_1f1b_equals_serial(mode, num_chunks, devices8):
+def test_sp_1f1b_equals_serial(mode, num_chunks, tp, devices8):
     """SP under the hand-rolled 1F1B backwards (plain AND interleaved
-    chunks): sequence-sharded stages with ring/Ulysses attention, the
-    forward slot running unconditionally (masked) so the seq collectives
-    stay uniform, blocks pcast varying over seq so the final
-    psum-over-seq assembles each shard's local grad paths exactly once —
-    loss and grads equal the serial model."""
+    chunks, AND composed with TP): sequence-sharded stages with
+    ring/Ulysses attention, the forward slot running unconditionally
+    (masked) so the seq collectives stay uniform, blocks pcast varying
+    over seq so the final psum-over-seq assembles each shard's local
+    grad paths exactly once (the TP 1/t normalization then composes
+    unchanged) — loss and grads equal the serial model."""
     S, sq, M, V = 2, 2, 2, num_chunks
-    params = llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+    cfg = CFG4H if tp > 1 else CFG
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
 
     def serial(p):
-        return causal_lm_loss(llama.llama_forward(p, tokens, CFG), tokens)
+        return causal_lm_loss(llama.llama_forward(p, tokens, cfg), tokens)
 
-    mesh = make_mesh(devices8[: S * sq], stage=S, seq=sq)
+    names = {"stage": S, "seq": sq}
+    kw = {}
+    if tp > 1:
+        names["model"] = tp
+        kw["tp_axis"] = "model"
+    mesh = make_mesh(devices8[: S * sq * tp], **names)
     staged = (
         llama.split_blocks_interleaved(params, S, V) if V > 1
         else llama.split_blocks_for_stages(params, S)
     )
     l, g = jax.jit(
         make_1f1b_value_and_grad(
-            CFG, mesh, M, seq_axis="seq", sp_mode=mode, num_chunks=V
+            cfg, mesh, M, seq_axis="seq", sp_mode=mode, num_chunks=V, **kw
         )
     )(staged, tokens)
     np.testing.assert_allclose(float(l), float(serial(params)), rtol=1e-5)
@@ -1260,10 +1274,7 @@ def test_pipeline_sp_tp_equals_serial(mode, devices8):
     Megatron-split matmuls operate on the per-shard head subset, ring /
     Ulysses attention runs over the seq axis within each stage, and loss
     + grads equal the serial model."""
-    cfg = LlamaConfig(
-        vocab_size=64, dmodel=32, num_heads=4, n_layers=4, ctx_size=16,
-        dtype="float32",
-    )
+    cfg = CFG4H
     S, sq, T, M = 2, 2, 2, 2
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
