@@ -318,19 +318,21 @@ def make_pipeline_loss(
     Activations crossing stage boundaries stay sequence-sharded, so the
     per-device boundary traffic ALSO falls by ``n``.  Composes with
     ``tp_axis`` (PP x SP x TP: the attention fns operate on the local
-    head subset the TP column slices produce).  Dense blocks, plain
-    schedule only (``n_experts``/``ep_axis``/``num_chunks``
-    compositions with SP are guarded off).
+    head subset the TP column slices produce) and with switch-MoE
+    blocks (``cfg.n_experts > 0``: per-seq-shard dispatch groups, the
+    aux term on its own scan carry — equal to ``make_sp_loss`` per
+    microbatch).  Plain schedule only; ``ep_axis``/``num_chunks``
+    compositions with SP are guarded off.
     """
     S = mesh.shape[stage_axis]
     M = num_microbatches
     V = num_chunks
     dtype = jnp.dtype(cfg.dtype)
     if seq_axis is not None:
-        if cfg.n_experts > 0 or ep_axis is not None:
+        if ep_axis is not None:
             raise NotImplementedError(
-                "SP inside the pipeline ships dense blocks; the sharded "
-                "MoE aux estimator under a seq axis is not wired"
+                "seq_axis with ep_axis is not wired (the EP a2a over "
+                "data and the ring over seq are untested together)"
             )
         if V > 1:
             raise NotImplementedError(
@@ -398,7 +400,7 @@ def make_pipeline_loss(
         )
 
         def tick(carry, t):
-            incoming, loss_sum = carry
+            incoming, loss_sum, aux_sum = carry
             # forward slot k = t - s; the slot -> (chunk v, microbatch m)
             # map is Megatron's interleaved grouping (see
             # make_interleaved_pipeline_loss), reducing to plain GPipe
@@ -428,7 +430,7 @@ def make_pipeline_loss(
             if cfg.n_experts > 0:
                 x_out, aux = llama.apply_blocks(
                     chunk, x_in, cfg, with_aux=True, moe_fn=moe_fn,
-                    tp_axis=tp_axis,
+                    tp_axis=tp_axis, **block_kw
                 )
                 # aux from drain-tick garbage is masked (the weight also
                 # zeroes its cotangent)
@@ -474,28 +476,38 @@ def make_pipeline_loss(
             outgoing = lax.ppermute(
                 x_out, stage_axis, [(i, (i + 1) % S) for i in range(S)]
             )
-            return (outgoing, loss_sum + loss_mb + aux_term), None
+            # the aux loss rides its OWN carry: under seq_axis the CE
+            # slot holds token-count-normalized SUMS while aux stays a
+            # per-dispatch-group mean — one denominator cannot serve both
+            return (outgoing, loss_sum + loss_mb, aux_sum + aux_term), None
 
         carry0 = (
             lax.pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
             lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            lax.pcast(jnp.float32(0.0), axes, to="varying"),
         )
         tick_fn = jax.checkpoint(tick) if remat else tick
-        (_, loss_sum), _ = lax.scan(
+        (_, loss_sum, aux_sum), _ = lax.scan(
             tick_fn, carry0, jnp.arange(M * V + S - 1)
         )
 
         total = lax.psum(loss_sum, stage_axis)
+        aux_total = lax.psum(aux_sum, stage_axis) / M
         if seq_axis is not None:
             # the ticks banked LOCAL CE sums; one psum over seq and the
             # global-token-count mean reproduce the serial causal loss
-            # (L here is the local shard length)
+            # (L here is the local shard length).  The aux term is the
+            # mean over seq shards of per-shard dispatch-group losses —
+            # the standard sharded-MoE estimator, exactly
+            # make_sp_loss's (per microbatch)
             n_seq = lax.psum(1, seq_axis)
             total = lax.psum(total, seq_axis) / (
                 M * mb * (L * n_seq - 1)
             )
+            aux_total = lax.pmean(aux_total, seq_axis)
         else:
             total = total / M
+        total = total + aux_total
         if data_axis is not None:
             total = lax.pmean(total, data_axis)
         if tp_axis is not None:

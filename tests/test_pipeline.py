@@ -1216,7 +1216,59 @@ def test_pipeline_sp_train_step_and_guards(devices8):
             CFG, tx, mesh, M, seq_axis="seq", schedule="1f1b-stash"
         )
     with pytest.raises(NotImplementedError, match="dense"):
-        make_pipeline_loss(MOE_CFG, mesh, M, seq_axis="seq")
+        make_1f1b_value_and_grad(MOE_CFG, mesh, M, seq_axis="seq")
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_pipeline_sp_moe_equals_sp_oracle(tp, devices8):
+    """Switch-MoE under SP x PP (round 5), with and without TP inside
+    the stages: per-(seq-shard, layer, microbatch) dispatch groups with
+    the aux term on its OWN scan carry (the CE slot holds
+    token-count-normalized sums under seq — one denominator cannot
+    serve both).  The oracle is make_sp_loss itself, per microbatch on
+    a seq-only mesh: identical routing groups and the identical
+    sharded-MoE aux estimator, so equality is exact (TP members compute
+    identical global routing, so the same oracle serves tp > 1)."""
+    from ddl25spring_tpu.parallel.sp import make_sp_loss
+
+    S, sq, M = 2, 2, 2
+    cfg = (
+        LlamaConfig(
+            vocab_size=64, dmodel=32, num_heads=4, n_layers=4,
+            ctx_size=16, dtype="float32", n_experts=4,
+            capacity_factor=2.0,
+        )
+        if tp > 1 else MOE_CFG
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    names = {"stage": S, "seq": sq}
+    kw = {}
+    if tp > 1:
+        names["model"] = tp
+        kw["tp_axis"] = "model"
+    mesh = make_mesh(devices8[: S * sq * tp], **names)
+    staged = llama.split_blocks_for_stages(params, S)
+    loss = make_pipeline_loss(cfg, mesh, M, seq_axis="seq", **kw)
+    l, g = jax.jit(jax.value_and_grad(loss))(staged, tokens)
+
+    mesh_sq = make_mesh(devices8[:sq], seq=sq)
+    sp_loss = make_sp_loss(cfg, mesh_sq, seq_axis="seq")
+
+    def oracle(p):
+        mbs = tokens.reshape(M, tokens.shape[0] // M, -1)
+        return jnp.mean(
+            jnp.stack([sp_loss(p, mbs[m]) for m in range(M)])
+        )
+
+    np.testing.assert_allclose(float(l), float(oracle(params)), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), atol=2e-4, rtol=2e-3
+        ),
+        jax.device_get(jax.grad(oracle)(params)),
+        jax.device_get(llama.merge_blocks_from_stages(g)),
+    )
 
 
 @pytest.mark.parametrize("mode,num_chunks,tp", [
