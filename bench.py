@@ -220,7 +220,25 @@ def main(argv=None) -> None:
                     help="overall wall-clock bound per bench subprocess")
     ap.add_argument("--no-fedavg", action="store_true",
                     help="skip the FedAvg round-time secondary metric")
+    ap.add_argument("--obs-dir", default=None, metavar="DIR",
+                    help="enable run telemetry (ddl25spring_tpu.obs) and "
+                         "write metrics.jsonl / counters.json / trace.json "
+                         "there; summarize with tools/obs_report.py")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU smoke run with telemetry: single-device DP, "
+                         "tiny dataset/steps, no FedAvg; writes "
+                         "--obs-dir (default runs/bench_smoke)")
     args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.cpu = True
+        args.no_fedavg = True
+        args.per_chip_batch = min(args.per_chip_batch, 64)
+        args.steps = min(args.steps, 8)
+        args.warmup = min(args.warmup, 2)
+        args.scan_steps = args.scan_steps or 1
+        args.obs_dir = args.obs_dir or "runs/bench_smoke"
+        os.environ.setdefault("DDL25_BENCH_NTRAIN", "512")
 
     on_cpu = args.cpu or args.force_cpu_devices
     if not on_cpu and os.environ.get("DDL25_BENCH_CHILD") != "1":
@@ -247,6 +265,7 @@ def main(argv=None) -> None:
 
     import time
 
+    from ddl25spring_tpu import obs
     from ddl25spring_tpu.benchmarks import (
         DeviceDataset,
         InputFeed,
@@ -256,6 +275,14 @@ def main(argv=None) -> None:
         timed_run,
     )
     from ddl25spring_tpu.utils.flops import chip_peak_flops, compiled_flops, mfu
+
+    lg = None
+    if args.obs_dir:
+        # enable BEFORE building the step so the on-device counters are
+        # traced in (the flag is read at trace time — obs/state.py)
+        obs.enable()
+        obs.set_recorder(obs.SpanRecorder(process_name="bench"))
+        obs.counters.reset()
 
     n = len(devices)
     dp, S = (n // 2, 2) if n >= 2 else (1, 1)
@@ -274,16 +301,33 @@ def main(argv=None) -> None:
         max(k for k in range(1, 17) if ds.batches_per_epoch % k == 0)
         if on_tpu else 1
     )
-    if K > 1:
-        multi, step, params, opt_state, meta = build_resnet_scan_step(
-            devices, dp, S, M, batch, K, ds.n
-        )
-    else:
-        multi = None
-        step, params, opt_state, meta = build_resnet_step(
-            devices, dp, S, M, batch
-        )
+    with obs.span("build_step", scan_steps=K):
+        if K > 1:
+            multi, step, params, opt_state, meta = build_resnet_scan_step(
+                devices, dp, S, M, batch, K, ds.n
+            )
+        else:
+            multi = None
+            step, params, opt_state, meta = build_resnet_step(
+                devices, dp, S, M, batch
+            )
     n_chips = meta["n_chips"]
+
+    if args.obs_dir:
+        lg = obs.MetricsLogger(
+            args.obs_dir,
+            meta=obs.run_metadata(
+                mesh=meta["mesh"],
+                layout=meta["layout"],
+                topology=meta["topology"],
+                n_chips=n_chips,
+                batch=batch,
+                num_stages=meta["num_stages"],
+                num_microbatches=meta["num_microbatches"],
+                scan_steps=K,
+                input_mode=ds.input_mode,
+            ),
+        )
 
     # --- primary: HBM shuffle; K steps fused per dispatch on TPU -----------
     if multi is not None:
@@ -303,6 +347,8 @@ def main(argv=None) -> None:
         dt, params, opt_state = timed_run(
             multi_packed, params, opt_state, feed_scan, n_disp,
             max(2, args.warmup // 2),
+            logger=lg, label="hbm-scan", samples_per_step=batch,
+            steps_per_call=K,
         )
         sps_chip = n_disp * K * batch / dt / n_chips
         dt_per_step = dt / (n_disp * K)
@@ -313,12 +359,14 @@ def main(argv=None) -> None:
         # single-dispatch run starts a fresh epoch instead of interleaving
         ds._i = 0
         dt0, params, opt_state = timed_run(
-            step, params, opt_state, ds.feed, args.steps, args.warmup
+            step, params, opt_state, ds.feed, args.steps, args.warmup,
+            logger=lg, label="hbm-single", samples_per_step=batch,
         )
         sps_chip_single = args.steps * batch / dt0 / n_chips
     else:
         dt, params, opt_state = timed_run(
-            step, params, opt_state, ds.feed, args.steps, args.warmup
+            step, params, opt_state, ds.feed, args.steps, args.warmup,
+            logger=lg, label="hbm-single", samples_per_step=batch,
         )
         sps_chip = args.steps * batch / dt / n_chips
         dt_per_step = dt / args.steps
@@ -335,13 +383,15 @@ def main(argv=None) -> None:
     feed = InputFeed(batch, stream=True, workers=workers, prefetch_depth=depth)
     stream_warm = args.warmup + depth + workers
     dt_s, params, opt_state = timed_run(
-        step, params, opt_state, feed.feed, args.steps, stream_warm
+        step, params, opt_state, feed.feed, args.steps, stream_warm,
+        logger=lg, label="stream", samples_per_step=batch,
     )
     sps_chip_stream = args.steps * batch / dt_s / n_chips
 
     # --- secondary 2: one fixed device-resident batch (compute bound) ------
     dt2, params, opt_state = timed_run(
-        step, params, opt_state, feed.feed_fixed, args.steps, args.warmup
+        step, params, opt_state, feed.feed_fixed, args.steps, args.warmup,
+        logger=lg, label="fixed-batch", samples_per_step=batch,
     )
     sps_chip_fixed = args.steps * batch / dt2 / n_chips
 
@@ -378,6 +428,43 @@ def main(argv=None) -> None:
     achieved_tf, frac = mfu(flops_step, dt_per_step, n_chips, meta["device"])
     peak = chip_peak_flops(meta["device"])
 
+    telemetry = {"enabled": False}
+    if lg is not None:
+        # supplementary header: facts only known after the timed phases
+        # (summarize_run merges header records in order)
+        lg.log(
+            record="header",
+            flops_per_step=flops_step,
+            peak_flops_per_chip=peak,
+            h2d_mib_per_s=h2d_mib_s,
+        )
+        lg.close()
+        obs.counters.save(args.obs_dir)
+        obs.get_recorder().save(os.path.join(args.obs_dir, "trace.json"))
+        from ddl25spring_tpu.obs.report import summarize_run
+
+        s = summarize_run(args.obs_dir)
+        telemetry = {
+            "enabled": True,
+            "run_dir": args.obs_dir,
+            "bubble_fraction": s.get("bubble_fraction"),
+            "tick_interval_s_p50": s.get("tick_interval_s_p50"),
+            "phases": {
+                name: {
+                    k: ph.get(k)
+                    for k in (
+                        "steps",
+                        "step_s_p50",
+                        "step_s_p95",
+                        "samples_per_sec_per_chip_p50",
+                        "mfu",
+                    )
+                    if ph.get(k) is not None
+                }
+                for name, ph in s.get("phases", {}).items()
+            },
+        }
+
     primary_mode = (
         f"{ds.input_mode}-scan{K}" if multi is not None else ds.input_mode
     )
@@ -399,6 +486,7 @@ def main(argv=None) -> None:
         scan_steps=K,
         peak_tflops_per_chip=peak / 1e12 if peak else None,
         h2d_mib_per_s=round(h2d_mib_s, 1),
+        telemetry=telemetry,
         secondary=single_line + [
             {
                 "input": feed.input_mode,

@@ -39,6 +39,7 @@ def build_resnet_step(
     batch: int,
     lr: float = 0.1,
     dtype: Any = None,
+    instrument: bool | None = None,
 ):
     """Build the north-star train step on ``devices[: dp * S]``.
 
@@ -48,6 +49,11 @@ def build_resnet_step(
     DP.  Returns ``(step, params, opt_state, meta)`` where
     ``step(params, opt_state, (x_u8, y))`` is jitted and ``meta`` carries
     layout/topology strings and chip count for reporting.
+
+    ``instrument`` threads through to the DP / pipeline builders
+    (:mod:`ddl25spring_tpu.obs` counters; None = follow the global flag,
+    True/False hard-enable/-disable,
+    zero-cost and HLO-identical when disabled).
     """
     if S not in (1, 2, 3, 4):
         raise ValueError(f"resnet pipeline supports S in (1, 2, 3, 4), got {S}")
@@ -83,7 +89,7 @@ def build_resnet_step(
             lambda logits, b: cross_entropy_logits(logits, b["y"]),
             (mb, 32, 32, 3), [(mb,) + s[1:] for s in shapes],
             tx, mesh, M, data_axis="data" if dp > 1 else None,
-            compute_dtype=dtype,
+            compute_dtype=dtype, instrument=instrument,
         )
 
         @jax.jit
@@ -103,7 +109,9 @@ def build_resnet_step(
             logits = model.apply({"params": p}, xb.astype(dtype), train=True)
             return cross_entropy_logits(logits, yb)
 
-        inner = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+        inner = make_dp_train_step(
+            loss_fn, tx, mesh, per_shard_rng=False, instrument=instrument
+        )
         key = jax.random.PRNGKey(1)
 
         @jax.jit
@@ -122,6 +130,8 @@ def build_resnet_step(
         "topology": topo,
         "device": devices[0],
         "mesh": mesh,
+        "num_stages": S,
+        "num_microbatches": M,
     }
     return step, params, opt_state, meta
 
@@ -136,6 +146,7 @@ def build_resnet_scan_step(
     n_data: int,
     lr: float = 0.1,
     dtype: Any = None,
+    instrument: bool | None = None,
 ):
     """K train steps per dispatch: the on-device input+train loop.
 
@@ -165,7 +176,8 @@ def build_resnet_scan_step(
     do automatically.
     """
     step1, params, opt_state, meta = build_resnet_step(
-        devices, dp, S, num_microbatches, batch, lr, dtype
+        devices, dp, S, num_microbatches, batch, lr, dtype,
+        instrument=instrument,
     )
     K = scan_steps
 
@@ -362,17 +374,69 @@ def report_line(layout, sps_chip, input_mode, frac, tf, **extra):
     })
 
 
-def timed_run(step, params, opt_state, feed, steps: int, warmup: int):
+def timed_run(
+    step,
+    params,
+    opt_state,
+    feed,
+    steps: int,
+    warmup: int,
+    logger=None,
+    label: str = "run",
+    samples_per_step: int | None = None,
+    steps_per_call: int = 1,
+):
     """Warmup (compile) then time ``steps`` calls; returns ``(dt, params,
     opt_state)``.  Forces completion via a host transfer — on this image's
-    tunneled TPU platform ``block_until_ready`` does not actually block."""
+    tunneled TPU platform ``block_until_ready`` does not actually block.
+
+    ``logger`` (an :class:`~ddl25spring_tpu.obs.MetricsLogger`): log one
+    ``step`` record per call — ``{step, wall_s, samples, loss, label}`` —
+    with host spans around warmup and the timed window.  Per-record wall
+    times require blocking on each call's loss (one scalar transfer), so
+    the telemetry path pays one extra host round-trip per dispatch — that
+    sync is inherent to per-step timing and stays in the measurement, but
+    the JSONL write+flush does NOT: the clock is re-armed after each
+    ``logger.log`` and the returned bulk ``dt`` is the sum of the
+    per-record walls, so logging I/O never inflates the headline.
+    ``steps_per_call`` scales the per-record sample count for scan-fused
+    dispatches (K train steps per call).
+    """
+    from ddl25spring_tpu import obs
+
     loss = None
-    for _ in range(warmup):
-        params, opt_state, loss = step(params, opt_state, feed())
-    if loss is not None:
-        float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, feed())
-    float(loss)  # the step chain is data-dependent through params
-    return time.perf_counter() - t0, params, opt_state
+    with obs.span("warmup", label=label, n=warmup):
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, feed())
+        if loss is not None:
+            float(loss)
+    if logger is None:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, feed())
+        float(loss)  # the step chain is data-dependent through params
+        return time.perf_counter() - t0, params, opt_state
+
+    total = 0.0
+    with obs.span("timed_run", label=label, steps=steps):
+        prev = time.perf_counter()
+        for i in range(steps):
+            with obs.span("step", label=label, i=i):
+                params, opt_state, loss = step(params, opt_state, feed())
+                lval = float(loss)  # force completion per call
+            wall = time.perf_counter() - prev
+            total += wall
+            logger.log(
+                step=i,
+                label=label,
+                wall_s=wall,
+                loss=lval,
+                **(
+                    {"samples": samples_per_step * steps_per_call}
+                    if samples_per_step
+                    else {}
+                ),
+                **({"fused_steps": steps_per_call} if steps_per_call > 1 else {}),
+            )
+            prev = time.perf_counter()  # logging I/O stays outside the window
+    return total, params, opt_state

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ddl25spring_tpu.models import llama
+from ddl25spring_tpu.utils.compat import pcast
 from ddl25spring_tpu.utils.config import LlamaConfig
 
 Params = dict[str, Any]
@@ -273,7 +274,7 @@ def generate(
         # activations — and hence logits — invariant.
         if tp_axis is None:
             return x
-        return lax.pcast(x, (tp_axis,), to="varying")
+        return pcast(x, (tp_axis,), to="varying")
 
     vary_logits = vary if shard_vocab else (lambda x: x)
     cache = jax.tree.map(vary, cache)
@@ -337,7 +338,7 @@ def make_tp_generate(
     :func:`generate` — pinned in ``tests/test_decode.py``."""
     from functools import partial as _partial
 
-    from jax import shard_map
+    from ddl25spring_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ddl25spring_tpu.parallel.tp import tp_param_specs

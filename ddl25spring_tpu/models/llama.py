@@ -180,7 +180,9 @@ def block_forward(
                 # invariant block indices).  Detect that context — varying
                 # mesh axes on the operand + non-TPU backend — and use the
                 # dense path there; flash stays the default on TPU.
-                in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
+                from ddl25spring_tpu.utils.compat import typeof
+
+                in_shard_map = bool(getattr(typeof(q), "vma", None))
                 if in_shard_map and jax.default_backend() != "tpu":
                     return causal_attention(q, k, v, dtype)
                 return flash_attention(q, k, v)

@@ -1,0 +1,65 @@
+"""Run telemetry that works everywhere the framework runs.
+
+The framework's perf story previously rested on two instruments: manual
+``perf_counter`` segments and the ``jax.profiler`` device tracer — and the
+tracer hangs indefinitely on tunneled TPU transports (RESULTS §6a), which
+is exactly the environment the benchmarks run in.  This package is the
+always-on, low-overhead substrate that does not depend on the XLA profiler
+being usable:
+
+- :mod:`~ddl25spring_tpu.obs.spans` — host-side nested span tracer
+  producing Chrome-trace/Perfetto JSON (and mirroring every span into
+  ``jax.profiler.TraceAnnotation`` so it shows inside real device traces
+  when those work);
+- :mod:`~ddl25spring_tpu.obs.logger` — append-only JSONL step metrics with
+  a run-metadata header (mesh, layout, git sha, jax version);
+- :mod:`~ddl25spring_tpu.obs.counters` — values from INSIDE jitted
+  programs via ``jax.debug.callback`` (MoE aux/load stats, pipeline tick
+  cadence, ZeRO collective bytes);
+- ``tools/obs_report.py`` — folds a run directory into a summary table
+  (steps/sec p50/p95, MFU, bubble fraction, h2d bandwidth).
+
+Everything is gated by one trace-time flag (:mod:`~ddl25spring_tpu.obs.
+state`): disabled (the default), instrumented step functions lower to HLO
+identical to uninstrumented ones — zero cost, pinned in
+``tests/test_obs.py``.  Enable with ``DDL25_OBS=1`` or ``obs.enable()``
+*before* building/tracing the step.
+"""
+
+from ddl25spring_tpu.obs.counters import (
+    CounterSet,
+    counters,
+    gpipe_bubble_fraction,
+)
+from ddl25spring_tpu.obs.logger import (
+    MetricsLogger,
+    iter_jsonl,
+    read_jsonl,
+    run_metadata,
+)
+from ddl25spring_tpu.obs.spans import (
+    SpanRecorder,
+    get_recorder,
+    instant,
+    set_recorder,
+    span,
+)
+from ddl25spring_tpu.obs.state import enable, enabled, scoped
+
+__all__ = [
+    "CounterSet",
+    "MetricsLogger",
+    "SpanRecorder",
+    "counters",
+    "enable",
+    "enabled",
+    "get_recorder",
+    "gpipe_bubble_fraction",
+    "instant",
+    "iter_jsonl",
+    "read_jsonl",
+    "run_metadata",
+    "scoped",
+    "set_recorder",
+    "span",
+]

@@ -1,0 +1,246 @@
+"""Fold a telemetry run directory into a summary (the analysis half of
+``tools/obs_report.py``, importable so ``bench.py`` can embed the same
+summary in its JSON line).
+
+A run directory is whatever :class:`~ddl25spring_tpu.obs.logger.
+MetricsLogger` + :class:`~ddl25spring_tpu.obs.counters.CounterSet` +
+:class:`~ddl25spring_tpu.obs.spans.SpanRecorder` wrote:
+
+    run_dir/metrics.jsonl   header + per-step records   (required)
+    run_dir/counters.json   scalar/series/static counters (optional)
+    run_dir/trace.json      Chrome-trace host spans       (optional)
+
+The summary derives steps/sec p50/p95 from the per-step ``wall_s``
+distribution (p50, not mean — one GC pause must not skew a bench line),
+MFU from the header's compiled-FLOPs + chip peak, and the GPipe bubble
+fraction from the header's (S, M) with measured tick cadence alongside
+when the pipeline counters fired.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from ddl25spring_tpu.obs.counters import gpipe_bubble_fraction
+from ddl25spring_tpu.obs.logger import read_jsonl
+
+
+def _pct(xs: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), q))
+
+
+def _phase_summary(steps: list[dict], header: dict) -> dict[str, Any]:
+    # scan-fused dispatches log one record per CALL covering k train steps
+    # (wall_s and samples are per-dispatch); normalize everything to
+    # per-train-step so fused and unfused phases report the same units
+    k = max((int(r.get("fused_steps") or 1) for r in steps), default=1)
+    wall = [float(r["wall_s"]) / k for r in steps if r.get("wall_s")]
+    out: dict[str, Any] = {"steps": len(steps) * k}
+    if k > 1:
+        out["fused_steps"] = k
+        out["dispatches"] = len(steps)
+    if not wall:
+        return out
+    p50, p95 = _pct(wall, 50), _pct(wall, 95)
+    out.update(
+        step_s_p50=p50,
+        step_s_p95=p95,
+        step_s_min=min(wall),
+        step_s_mean=sum(wall) / len(wall),
+        steps_per_sec_p50=1.0 / p50 if p50 > 0 else None,
+        steps_per_sec_p95=1.0 / p95 if p95 > 0 else None,
+    )
+    samples = [float(r["samples"]) / k for r in steps if r.get("samples")]
+    if samples and p50 > 0:
+        per_step = samples[0]
+        n_chips = int(header.get("n_chips") or 1)
+        out["samples_per_sec_p50"] = per_step / p50
+        out["samples_per_sec_per_chip_p50"] = per_step / p50 / n_chips
+    tokens = [float(r["tokens"]) / k for r in steps if r.get("tokens")]
+    if tokens and p50 > 0:
+        out["tokens_per_sec_p50"] = tokens[0] / p50
+    losses = [float(r["loss"]) for r in steps if r.get("loss") is not None]
+    if losses:
+        out["loss_last"] = losses[-1]
+
+    # MFU from the header's compiled-FLOPs count at this phase's p50
+    flops = header.get("flops_per_step")
+    if flops and p50 > 0:
+        n_chips = int(header.get("n_chips") or 1)
+        achieved = float(flops) / p50 / n_chips
+        out["achieved_tflops_per_chip"] = achieved / 1e12
+        peak = header.get("peak_flops_per_chip")
+        out["mfu"] = (achieved / float(peak)) if peak else None
+    return out
+
+
+def summarize_run(run_dir: str) -> dict[str, Any]:
+    """Summarize one run directory.  Raises FileNotFoundError when there is
+    no ``metrics.jsonl`` (nothing to report on)."""
+    metrics_path = os.path.join(run_dir, "metrics.jsonl")
+    records = read_jsonl(metrics_path)
+    # a run may append late header records for facts only known at the
+    # end (compiled flops, measured link bandwidth): merge them in order
+    header: dict[str, Any] = {}
+    for r in records:
+        if r.get("record") == "header":
+            header.update({k: v for k, v in r.items() if v is not None})
+    steps = [r for r in records if r.get("record") == "step"]
+
+    phases: dict[str, list[dict]] = {}
+    for r in steps:
+        phases.setdefault(r.get("label", "run"), []).append(r)
+
+    out: dict[str, Any] = {
+        "run_dir": run_dir,
+        "header": header,
+        "phases": {k: _phase_summary(v, header) for k, v in phases.items()},
+    }
+
+    # GPipe bubble: analytic from the recorded schedule shape; measured
+    # tick cadence alongside when the pipeline's tick counters fired
+    S = header.get("num_stages")
+    M = header.get("num_microbatches")
+    cpath = os.path.join(run_dir, "counters.json")
+    counters = None
+    if os.path.exists(cpath):
+        with open(cpath) as f:
+            counters = json.load(f)
+        statics = counters.get("static", {})
+        # the instrumented pipeline records its own (S, M); use them when
+        # the driver's header didn't carry the schedule shape
+        S = S or statics.get("pipeline.num_stages")
+        M = M or statics.get("pipeline.num_microbatches")
+    if S and M:
+        out["bubble_fraction"] = gpipe_bubble_fraction(S, M)
+        out.setdefault("num_stages", S)
+        out.setdefault("num_microbatches", M)
+    if counters is not None:
+        out["counters"] = counters
+        ticks = counters.get("series", {}).get("pipeline.tick")
+        if ticks and len(ticks) >= 3:
+            # the callback fires once per mesh shard, so every tick index
+            # arrives D times nearly simultaneously, and the index resets
+            # to 0 on each new scan invocation (next step / bwd recompute).
+            # Keep only the first arrival of each index and measure
+            # consecutive-index transitions within one scan pass — the
+            # raw diff's intra-tick gaps would swamp the median on D >= 3.
+            dts = []
+            prev_i = prev_t = None
+            for i, t in ticks:
+                if prev_i is not None and i == prev_i:
+                    continue  # another shard's arrival for the same tick
+                if prev_i is not None and i == prev_i + 1 and t > prev_t:
+                    dts.append(t - prev_t)
+                prev_i, prev_t = i, t
+            if dts:
+                out["tick_interval_s_p50"] = float(np.percentile(dts, 50))
+
+    tpath = os.path.join(run_dir, "trace.json")
+    if os.path.exists(tpath):
+        with open(tpath) as f:
+            trace = json.load(f)
+        evs = [
+            e for e in trace.get("traceEvents", []) if e.get("ph") == "X"
+        ]
+        out["span_counts"] = {
+            n: sum(1 for e in evs if e["name"] == n)
+            for n in sorted({e["name"] for e in evs})
+        }
+    return out
+
+
+def format_report(summary: dict[str, Any]) -> str:
+    """Render the summary as the aligned table the CLI prints."""
+    h = summary.get("header", {})
+    lines = [f"run: {summary['run_dir']}"]
+    meta_bits = []
+    for k in ("layout", "topology", "git_sha", "jax_version"):
+        if h.get(k):
+            v = h[k]
+            meta_bits.append(f"{k}={str(v)[:12] if k == 'git_sha' else v}")
+    if h.get("mesh"):
+        meta_bits.append(f"mesh={h['mesh']}")
+    if h.get("device"):
+        d = h["device"]
+        meta_bits.append(f"device={d.get('kind') or d.get('platform')}")
+    if meta_bits:
+        lines.append("  " + "  ".join(meta_bits))
+    lines.append("")
+
+    def fmt(v, unit="", nd=2):
+        if v is None:
+            return "n/a"
+        return f"{v:.{nd}f}{unit}"
+
+    cols = (
+        f"{'phase':<24}{'steps':>6}{'step p50':>12}{'step p95':>12}"
+        f"{'steps/s p50':>13}{'samp/s/chip':>13}{'MFU':>8}"
+    )
+    lines.append(cols)
+    lines.append("-" * len(cols))
+    for name, ph in summary.get("phases", {}).items():
+        lines.append(
+            f"{name:<24}{ph.get('steps', 0):>6}"
+            f"{fmt(ph.get('step_s_p50'), ' s', 4):>12}"
+            f"{fmt(ph.get('step_s_p95'), ' s', 4):>12}"
+            f"{fmt(ph.get('steps_per_sec_p50'), '', 2):>13}"
+            f"{fmt(ph.get('samples_per_sec_per_chip_p50'), '', 1):>13}"
+            f"{fmt(ph.get('mfu'), '', 4):>8}"
+        )
+    lines.append("")
+
+    bf = summary.get("bubble_fraction")
+    S = summary.get("num_stages") or h.get("num_stages")
+    M = summary.get("num_microbatches") or h.get("num_microbatches")
+    if bf is not None:
+        lines.append(
+            f"pipeline bubble fraction: {bf:.4f} "
+            f"(GPipe (S-1)/(M+S-1) at S={S}, M={M})"
+        )
+    else:
+        lines.append("pipeline bubble fraction: 0.0000 (no pipeline axis)")
+    if summary.get("tick_interval_s_p50") is not None:
+        lines.append(
+            f"measured tick interval p50: "
+            f"{summary['tick_interval_s_p50'] * 1e3:.2f} ms"
+        )
+    if h.get("h2d_mib_per_s"):
+        lines.append(f"host->device link: {h['h2d_mib_per_s']:.1f} MiB/s")
+
+    for name, ph in summary.get("phases", {}).items():
+        if ph.get("achieved_tflops_per_chip") is not None:
+            lines.append(
+                f"achieved TFLOP/s/chip ({name}): "
+                f"{ph['achieved_tflops_per_chip']:.2f}"
+                + (
+                    ""
+                    if ph.get("mfu") is not None
+                    else "  (no TPU peak on this platform; MFU n/a)"
+                )
+            )
+            break
+
+    c = summary.get("counters", {})
+    statics = c.get("static", {})
+    scalars = c.get("scalars", {})
+    if statics or scalars:
+        lines.append("")
+        lines.append("counters:")
+        for k, v in sorted(statics.items()):
+            lines.append(f"  {k:<40} {v}")
+        for k, s in sorted(scalars.items()):
+            lines.append(
+                f"  {k:<40} count={int(s['count'])} mean={s['mean']:.6g} "
+                f"last={s.get('last', float('nan')):.6g}"
+            )
+    if summary.get("span_counts"):
+        lines.append("")
+        lines.append("host spans (trace.json — load in Perfetto):")
+        for n, cnt in summary["span_counts"].items():
+            lines.append(f"  {n:<40} x{cnt}")
+    return "\n".join(lines)

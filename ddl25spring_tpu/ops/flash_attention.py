@@ -49,9 +49,11 @@ def _sds(shape, dtype, *refs):
     — without this the kernel cannot be used inside the pipeline/DP
     shard_maps.  Outside shard_map every vma is empty and this degrades to
     a plain ShapeDtypeStruct."""
+    from ddl25spring_tpu.utils.compat import typeof
+
     vma: frozenset = frozenset()
     for r in refs:
-        vma = vma | getattr(jax.typeof(r), "vma", frozenset())
+        vma = vma | getattr(typeof(r), "vma", frozenset())
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -63,7 +65,9 @@ def _pos(base, n: int):
 
 
 def _params3():
-    return pltpu.CompilerParams(dimension_semantics=_DIMS3)
+    # renamed TPUCompilerParams -> CompilerParams in newer pallas
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=_DIMS3)
 
 
 # ------------------------------------------------------------------ forward

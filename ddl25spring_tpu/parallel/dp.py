@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ddl25spring_tpu.utils.compat import HAS_VMA, pcast, shard_map
 
 # loss_fn(params, batch, key) -> scalar
 LossFn = Callable[[Any, Any, jax.Array], jax.Array]
@@ -61,6 +61,7 @@ def make_dp_train_step(
     mesh: Mesh,
     axis: str = "data",
     per_shard_rng: bool = True,
+    instrument: bool | None = None,
 ):
     """Gradient-aggregation DP trainstep over ``mesh[axis]``.
 
@@ -68,7 +69,18 @@ def make_dp_train_step(
     replicated.  ``per_shard_rng`` folds the shard index into the dropout key
     so different shards don't reuse dropout masks (set False for bitwise
     serial-equivalence tests with deterministic losses).
+
+    ``instrument``: telemetry counters (loss + grad-norm via
+    ``jax.debug.callback``, :mod:`ddl25spring_tpu.obs`) — ``None`` follows
+    the global obs flag at build time, ``True``/``False`` hard-enable/
+    -disable regardless of the flag.  Disabled,
+    the step lowers to HLO identical to an uninstrumented build (pinned in
+    ``tests/test_obs.py``); enabled, the callbacks cost one host transfer
+    per step.
     """
+    from ddl25spring_tpu import obs
+
+    instr = obs.enabled() if instrument is None else bool(instrument)
 
     @partial(
         shard_map,
@@ -88,11 +100,27 @@ def make_dp_train_step(
         def global_loss(params):
             return lax.pmean(loss_fn(params, batch, key), axis)
 
-        return jax.value_and_grad(global_loss)(params)
+        loss, grads = jax.value_and_grad(global_loss)(params)
+        if not HAS_VMA:
+            # pre-VMA jax can't see that ``params`` is axis-invariant, and
+            # its psum transposes to psum (the pmap convention), so the
+            # body-level autodiff hands each shard its UNREDUCED local
+            # gradient; the explicit pmean completes the all_reduce+divide.
+            # On current jax the invariant-param transpose already reduced
+            # — another collective here would be wrong, hence the gate.
+            grads = lax.pmean(grads, axis)
+        return loss, grads
 
     @jax.jit
     def step(params, opt_state, batch, key):
         loss, grads = loss_and_pmean_grad(params, batch, key)
+        if instr:
+            obs.counters.emit("dp.loss", loss, force=True)
+            gnorm_sq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+            obs.counters.emit("dp.grad_norm", jnp.sqrt(gnorm_sq), force=True)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -129,7 +157,7 @@ def make_dp_weight_avg_step(
         # Mark params as axis-varying so autodiff yields LOCAL grads (no
         # implicit cross-shard psum) — each replica steps on its own data,
         # as each reference rank does before the weight sync.
-        local_params = lax.pcast(params, axis, to="varying")
+        local_params = pcast(params, axis, to="varying")
         loss, grads = jax.value_and_grad(loss_fn)(local_params, batch, key)
         updates, opt_state = tx.update(grads, opt_state, local_params)
         stepped = optax.apply_updates(local_params, updates)

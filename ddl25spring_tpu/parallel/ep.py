@@ -31,7 +31,9 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Params = dict[str, Any]
@@ -186,7 +188,7 @@ def ep_moe_local(
     C = max(1, int(T_local * capacity_factor * top_k / E))
     router = p["router"]
     if vary_axes:
-        router = lax.pcast(router, vary_axes, to="varying")
+        router = pcast(router, vary_axes, to="varying")
     logits = x.astype(jnp.float32) @ router
     disp, combine, aux, kept = _dispatch_tensors(logits, C, top_k)
 

@@ -40,7 +40,9 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 Params = Any
@@ -62,6 +64,7 @@ def make_het_pipeline_loss(
     stage_axis: str = "stage",
     data_axis: str | None = None,
     compute_dtype: Any = jnp.float32,
+    instrument: bool | None = None,
 ):
     """Build ``loss(params_per_stage, batch) -> scalar`` for S heterogeneous
     stages on the mesh ``stage`` axis.
@@ -74,10 +77,27 @@ def make_het_pipeline_loss(
     ``batch`` is a pytree whose leaves lead with the global batch dim
     ``B = num_microbatches * mb * data_parallelism``; ``inject_fn(mb_batch)``
     extracts stage-0's input (default: the batch's ``"x"`` entry).
+
+    ``instrument`` (None = follow the global :mod:`ddl25spring_tpu.obs`
+    flag at build time; True/False hard-enable/-disable): each scan tick marks its host arrival time via
+    ``jax.debug.callback`` so tick cadence (and thus the realized GPipe
+    bubble) is observable without any device profiler; the schedule shape
+    (S, M) is recorded as static counters.  Disabled, the lowered HLO is
+    identical to an uninstrumented build.
     """
+    from ddl25spring_tpu import obs
+
     S = len(stage_fns)
     assert S == mesh.shape[stage_axis], (S, mesh.shape)
     M = num_microbatches
+    instr = obs.enabled() if instrument is None else bool(instrument)
+    if instr:
+        obs.counters.add_static("pipeline.num_stages", S)
+        obs.counters.add_static("pipeline.num_microbatches", M)
+        obs.counters.add_static(
+            "pipeline.bubble_fraction_gpipe",
+            obs.gpipe_bubble_fraction(S, M),
+        )
     shapes = [tuple(in_shape)] + [tuple(s) for s in boundary_shapes]
     mb = shapes[0][0]
     assert all(s[0] == mb for s in shapes), f"microbatch dims differ: {shapes}"
@@ -97,7 +117,7 @@ def make_het_pipeline_loss(
         axes = (stage_axis,) + ((data_axis,) if data_axis else ())
         # varying copies so the transpose's cotangent psum over the stage
         # axis runs uniformly on every device (not inside switch branches)
-        vparams = lax.pcast(params, axes, to="varying")
+        vparams = pcast(params, axes, to="varying")
 
         def pack(x):
             flat = x.reshape(mb, -1).astype(compute_dtype)
@@ -109,6 +129,10 @@ def make_het_pipeline_loss(
 
         def tick(carry, t):
             buf_in, loss_sum = carry
+            if instr:
+                # host arrival time of each tick: the cadence estimator
+                # for the realized (not just analytic) bubble fraction
+                obs.counters.mark("pipeline.tick", t, force=True)
             mb_t = jax.tree.map(lambda x: x[jnp.minimum(t, M - 1)], batch_mb)
 
             def branch(i):
@@ -130,7 +154,7 @@ def make_het_pipeline_loss(
             loss_mb = lax.cond(
                 jnp.logical_and(s == S - 1, done >= 0),
                 lambda b, y: loss_fn(unpack(b, shapes[S]).astype(jnp.float32), y),
-                lambda b, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                lambda b, y: pcast(jnp.float32(0.0), axes, to="varying"),
                 buf_out,
                 mb_done,
             )
@@ -141,10 +165,10 @@ def make_het_pipeline_loss(
             return (outgoing, loss_sum + loss_mb), None
 
         carry0 = (
-            lax.pcast(
+            pcast(
                 jnp.zeros((mb, buf_elems), compute_dtype), axes, to="varying"
             ),
-            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            pcast(jnp.float32(0.0), axes, to="varying"),
         )
         (_, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
 
@@ -280,7 +304,7 @@ def make_sharded_het_pipeline_loss(
         # pcast over data so cotangents stay per-shard until the final pmean
         local_flat = stacked[0]
         if data_axis:
-            local_flat = lax.pcast(local_flat, data_axis, to="varying")
+            local_flat = pcast(local_flat, data_axis, to="varying")
 
         def pack(x):
             flat = x.reshape(mb, -1).astype(compute_dtype)
@@ -314,7 +338,7 @@ def make_sharded_het_pipeline_loss(
             loss_mb = lax.cond(
                 jnp.logical_and(s == S - 1, done >= 0),
                 lambda b, y: loss_fn(unpack(b, shapes[S]).astype(jnp.float32), y),
-                lambda b, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                lambda b, y: pcast(jnp.float32(0.0), axes, to="varying"),
                 buf_out,
                 mb_done,
             )
@@ -325,10 +349,10 @@ def make_sharded_het_pipeline_loss(
             return (outgoing, loss_sum + loss_mb), None
 
         carry0 = (
-            lax.pcast(
+            pcast(
                 jnp.zeros((mb, buf_elems), compute_dtype), axes, to="varying"
             ),
-            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            pcast(jnp.float32(0.0), axes, to="varying"),
         )
         (_, loss_sum), _ = lax.scan(tick, carry0, jnp.arange(M + S - 1))
 

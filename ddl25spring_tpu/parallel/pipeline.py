@@ -36,7 +36,9 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl25spring_tpu.models import llama
@@ -256,8 +258,19 @@ def make_pipeline_loss(
     tp_axis: str | None = None,
     seq_axis: str | None = None,
     sp_mode: str = "ring",
+    instrument: bool | None = None,
 ):
     """Build ``loss(params, tokens) -> scalar`` running the GPipe schedule.
+
+    ``instrument`` (None = follow the global :mod:`ddl25spring_tpu.obs`
+    flag at build time; True/False hard-enable/-disable): every scan tick marks its host arrival time, and
+    switch-MoE configs additionally emit each tick's router load-balance
+    aux term (the ``f·P`` load/importance product the aux loss measures) —
+    all via ``jax.debug.callback``, usable where the XLA profiler is not.
+    Note the counters fire during the FORWARD pass; under ``remat=True``
+    the backward's recompute fires them again (counter means are unbiased,
+    counts double).  Disabled, the lowered HLO is identical to an
+    uninstrumented build.
 
     ``params`` is a llama pytree with blocks pre-split by
     :func:`~ddl25spring_tpu.models.llama.split_blocks_for_stages` into
@@ -324,10 +337,21 @@ def make_pipeline_loss(
     microbatch).  Plain schedule only; ``ep_axis``/``num_chunks``
     compositions with SP are guarded off.
     """
+    from ddl25spring_tpu import obs
+
     S = mesh.shape[stage_axis]
     M = num_microbatches
     V = num_chunks
     dtype = jnp.dtype(cfg.dtype)
+    instr = obs.enabled() if instrument is None else bool(instrument)
+    if instr:
+        obs.counters.add_static("pipeline.num_stages", S)
+        obs.counters.add_static("pipeline.num_microbatches", M)
+        obs.counters.add_static("pipeline.num_chunks", V)
+        obs.counters.add_static(
+            "pipeline.bubble_fraction_gpipe",
+            obs.gpipe_bubble_fraction(S, M * V),
+        )
     if seq_axis is not None:
         if ep_axis is not None:
             raise NotImplementedError(
@@ -393,7 +417,7 @@ def make_pipeline_loss(
         # uniformly on every device.  Using the invariant originals inside
         # ``lax.cond`` would put that psum inside a branch only the last
         # stage takes — a collective in non-uniform control flow.
-        head = lax.pcast(
+        head = pcast(
             {k: params[k] for k in ("embed", "ln_f", "unembed")},
             axes,
             to="varying",
@@ -401,6 +425,10 @@ def make_pipeline_loss(
 
         def tick(carry, t):
             incoming, loss_sum, aux_sum = carry
+            if instr:
+                # host arrival time per tick — the cadence estimator for
+                # the realized bubble (vs the analytic (S-1)/(M+S-1))
+                obs.counters.mark("pipeline.tick", t, force=True)
             # forward slot k = t - s; the slot -> (chunk v, microbatch m)
             # map is Megatron's interleaved grouping (see
             # make_interleaved_pipeline_loss), reducing to plain GPipe
@@ -436,6 +464,11 @@ def make_pipeline_loss(
                 # zeroes its cotangent)
                 w_f = jnp.where(active, 1.0, 0.0).astype(jnp.float32)
                 aux_term = w_f * jnp.float32(cfg.moe_aux_weight) * aux
+                if instr:
+                    # router load-balance per ACTIVE tick: the E·Σ f_e·P_e
+                    # product the aux loss measures (1.0 = perfectly
+                    # balanced routing; drain ticks excluded by the mask)
+                    obs.counters.emit("pipeline.moe_aux", w_f * aux, force=True)
             else:
                 x_out = llama.apply_blocks(
                     chunk, x_in, cfg, tp_axis=tp_axis, **block_kw
@@ -464,7 +497,7 @@ def make_pipeline_loss(
             loss_mb = lax.cond(
                 jnp.logical_and(finish, active),
                 loss_branch,
-                lambda x, y: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                lambda x, y: pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
                 targets_mb[m],
             )
@@ -482,9 +515,9 @@ def make_pipeline_loss(
             return (outgoing, loss_sum + loss_mb, aux_sum + aux_term), None
 
         carry0 = (
-            lax.pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
-            lax.pcast(jnp.float32(0.0), axes, to="varying"),
-            lax.pcast(jnp.float32(0.0), axes, to="varying"),
+            pcast(jnp.zeros((mb, L, cfg.dmodel), dtype), axes, to="varying"),
+            pcast(jnp.float32(0.0), axes, to="varying"),
+            pcast(jnp.float32(0.0), axes, to="varying"),
         )
         tick_fn = jax.checkpoint(tick) if remat else tick
         (_, loss_sum, aux_sum), _ = lax.scan(
@@ -759,7 +792,7 @@ def make_1f1b_value_and_grad(
             + ((seq_axis,) if seq_axis else ())
         )
 
-        head = lax.pcast(
+        head = pcast(
             {k: params[k] for k in ("embed", "ln_f", "unembed")},
             axes,
             to="varying",
@@ -778,17 +811,17 @@ def make_1f1b_value_and_grad(
             # data axis; pcast only the data-invariant leaves (ep and
             # seq are mutually exclusive, so vary == (data_axis,))
             vblocks = {
-                k: lax.pcast(v, vary, to="varying")
+                k: pcast(v, vary, to="varying")
                 for k, v in local_blocks.items() if k != "moe"
             }
             vblocks["moe"] = dict(
                 local_blocks["moe"],
-                router=lax.pcast(
+                router=pcast(
                     local_blocks["moe"]["router"], vary, to="varying"
                 ),
             )
         elif vary:
-            vblocks = lax.pcast(local_blocks, vary, to="varying")
+            vblocks = pcast(local_blocks, vary, to="varying")
         else:
             vblocks = local_blocks
 
@@ -850,7 +883,7 @@ def make_1f1b_value_and_grad(
             loss = lax.cond(
                 finish,
                 loss_branch,
-                lambda x: lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                lambda x: pcast(jnp.float32(0.0), axes, to="varying"),
                 x_out,
             )
             return x_out, loss + aux_term
@@ -957,7 +990,7 @@ def make_1f1b_value_and_grad(
             # dense slots output the constant 0 (zero pullback), and MoE
             # chunks need their aux term differentiated
             g_out = jnp.where(finish_b, jnp.zeros_like(cot_in), cot_in)
-            g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + 1.0
+            g_loss = pcast(jnp.float32(0.0), axes, to="varying") + 1.0
             db, dh, dx = pull((g_out.astype(x_out_b.dtype), g_loss))
 
             w = jnp.where(bwd_active, jnp.float32(1.0), jnp.float32(0.0))
@@ -982,7 +1015,7 @@ def make_1f1b_value_and_grad(
             return (fwd_next, cot_next, ring, gblocks, ghead, loss_sum), None
 
         def vzeros(x, dt=None):
-            return lax.pcast(
+            return pcast(
                 jnp.zeros(jnp.shape(x), dt or jnp.result_type(x)),
                 axes, to="varying",
             )
@@ -1018,7 +1051,7 @@ def make_1f1b_value_and_grad(
             )
             ex_cot = (
                 vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),
-                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                pcast(jnp.float32(0.0), axes, to="varying"),
             )
             _, ex_consts = jax.closure_convert(ex_pull, ex_cot)
             # ring slots start from the VALID example residuals, not zeros:
@@ -1069,7 +1102,7 @@ def make_1f1b_value_and_grad(
                 consts_b = [r[idx_r] for r in ring]
                 tok_b = tok_ring[idx_r]
                 g_out = jnp.where(is_last, jnp.zeros_like(cot_in), cot_in)
-                g_loss = lax.pcast(jnp.float32(0.0), axes, to="varying") + 1.0
+                g_loss = pcast(jnp.float32(0.0), axes, to="varying") + 1.0
                 db, dh, dx = pull_conv(
                     (g_out.astype(x_out.dtype), g_loss), *consts_b
                 )
@@ -1102,7 +1135,7 @@ def make_1f1b_value_and_grad(
                 ring0,
                 tok_ring0,
                 *gzero,
-                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                pcast(jnp.float32(0.0), axes, to="varying"),
             )
             (_, _, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
                 tick_res, carry0, jnp.arange(T)
@@ -1113,7 +1146,7 @@ def make_1f1b_value_and_grad(
                 vzeros(jnp.empty((mb, L, cfg.dmodel)), dtype),      # cotangent
                 vzeros(jnp.empty((K + 1, mb, L, cfg.dmodel)), dtype),  # stash
                 *gzero,
-                lax.pcast(jnp.float32(0.0), axes, to="varying"),
+                pcast(jnp.float32(0.0), axes, to="varying"),
             )
             (_, _, _, gblocks, ghead, loss_sum), _ = lax.scan(
                 tick, carry0, jnp.arange(T)

@@ -38,7 +38,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ddl25spring_tpu.models import llama
@@ -339,7 +341,7 @@ def make_sp_loss(
     )
     def sp_loss(params: Params, tokens: jax.Array) -> jax.Array:
         axes = (seq_axis,) + ((data_axis,) if data_axis else ())
-        vparams = lax.pcast(params, axes, to="varying")
+        vparams = pcast(params, axes, to="varying")
         B, Ll = tokens.shape
         offset = lax.axis_index(seq_axis) * Ll
         pos = offset + jnp.arange(Ll)

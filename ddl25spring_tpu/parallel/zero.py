@@ -43,7 +43,7 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ddl25spring_tpu.utils.compat import pcast, shard_map
 
 LossFn = Callable[[Any, Any, jax.Array], jax.Array]
 
@@ -93,6 +93,7 @@ def make_zero_dp_train_step(
     axis: str = "data",
     per_shard_rng: bool = True,
     num_microbatches: int = 1,
+    instrument: bool | None = None,
 ):
     """Build the fully-sharded trainstep.
 
@@ -110,6 +111,13 @@ def make_zero_dp_train_step(
     of shard square-norms makes it exact); other global-reduction
     transforms need the same treatment before they are safe here.
 
+    ``instrument`` (None = follow the global :mod:`ddl25spring_tpu.obs`
+    flag at build time; True/False hard-enable/-disable): records the per-step ICI volume — the bytes one
+    device gathers (all_gather) and reduce-scatters per step, derived from
+    the padded ``[n, k]`` layout at trace time — as static counters, and
+    emits the per-step loss via ``jax.debug.callback``.  Disabled, the
+    lowered HLO is identical to an uninstrumented build.
+
     ``num_microbatches > 1`` adds FSDP-style gradient accumulation: the
     per-device batch is split along its leading dim and scanned — each
     microbatch re-gathers params and reduce-scatters its gradient (the
@@ -119,11 +127,28 @@ def make_zero_dp_train_step(
     (mean of microbatch means; same reference semantics as
     ``s01_b1_microbatches.py``'s ``.grad`` accumulation).
     """
+    from ddl25spring_tpu import obs
+
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
     n = mesh.shape[axis]
     shapes = jax.tree.map(lambda l: jnp.shape(l), params_template)
     dtypes = jax.tree.map(lambda l: jnp.result_type(l), params_template)
+
+    instr = obs.enabled() if instrument is None else bool(instrument)
+    if instr:
+        # per-device ICI volume per step, from the padded [n, k] layout:
+        # each device RECEIVES (n-1)/n of every gathered leaf and sends
+        # the mirror amount in the backward's reduce-scatter; the
+        # microbatch loop re-runs both per microbatch
+        gathered = sum(
+            n * _leaf_meta(leaf, n)[1] * jnp.result_type(leaf).itemsize
+            for leaf in jax.tree.leaves(params_template)
+        )
+        wire = gathered * (n - 1) // n * num_microbatches
+        obs.counters.add_static("zero.allgather_bytes_per_step", wire)
+        obs.counters.add_static("zero.reduce_scatter_bytes_per_step", wire)
+        obs.counters.add_static("zero.params_bytes_gathered", gathered)
 
     def gather_full(shards):
         def g(s, shape, dtype):
@@ -202,7 +227,7 @@ def make_zero_dp_train_step(
                 zero_g = jax.tree.map(jnp.zeros_like, pshards)
                 # the per-microbatch loss is device-varying; the init must
                 # match (VMA typing under shard_map)
-                zero_l = lax.pcast(jnp.float32(0.0), axis, to="varying")
+                zero_l = pcast(jnp.float32(0.0), axis, to="varying")
                 (loss, gshards), _ = lax.scan(
                     acc_body,
                     (zero_l, zero_g),
@@ -217,6 +242,8 @@ def make_zero_dp_train_step(
             # device's gshards already hold the cross-device SUM of local
             # grads for its rows; ÷n converts sum to the DP mean
             gshards = jax.tree.map(lambda g: g / n, gshards)
+            if instr:
+                obs.counters.emit("zero.loss", lax.pmean(loss, axis), force=True)
             updates, ostate = tx.update(gshards, ostate, pshards)
             pshards = optax.apply_updates(pshards, updates)
             return pshards, ostate, lax.pmean(loss, axis)

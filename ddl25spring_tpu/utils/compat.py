@@ -1,0 +1,73 @@
+"""JAX version compatibility for the manual-SPMD primitives.
+
+The parallel stack is written against the current ``jax.shard_map`` API
+with varying-manual-axes (VMA) typing: ``lax.pcast(x, axes, to="varying")``
+marks a value as device-varying so shard_map's rep-checker accepts
+non-uniform control flow and the transpose inserts cotangent psums in the
+right places.  Older jax (<= 0.4.x, e.g. this build image's 0.4.37) ships
+``shard_map`` under ``jax.experimental`` and has no ``pcast`` / VMA typing
+at all — there, rep-checking is the coarse ``check_rep`` flag and every
+value inside the body is implicitly allowed to vary.
+
+This module is the single import point for both symbols:
+
+- :func:`shard_map` — the current top-level API when present; otherwise the
+  experimental one with ``check_rep=False`` (the VMA annotations the code
+  carries are exactly the facts ``check_rep=True`` cannot verify on the old
+  tracer, and the collectives/psums are all explicit in this codebase, so
+  disabling the checker changes nothing about the lowered program);
+- :func:`pcast` — ``lax.pcast`` when present, identity otherwise (on old
+  jax there is no varying/invariant distinction to cast between).
+
+Keeping the call sites written against the NEW API (and shimming the old
+one) means the code reads idiomatically on current jax and still imports
+and runs — tests, CPU smokes, bench — on the older runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from jax import lax
+
+try:  # jax >= 0.6: top-level export, VMA typing
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # jax <= 0.4.x: experimental API, check_rep world
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+HAS_VMA = hasattr(lax, "pcast")
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` across versions (usable as ``partial(shard_map,
+    mesh=..., in_specs=..., out_specs=...)`` decorator like the real one)."""
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    if _LEGACY:
+        kwargs.setdefault("check_rep", False)
+    return _shard_map(f, **kwargs)
+
+
+if HAS_VMA:
+    pcast = lax.pcast
+else:
+
+    def pcast(x, axis_name, to="varying"):
+        """No-op stand-in for ``lax.pcast`` on pre-VMA jax: without the
+        varying/invariant type system there is nothing to cast."""
+        del axis_name, to
+        return x
+
+
+def typeof(x):
+    """``jax.typeof`` across versions.  Callers only probe the aval's
+    ``vma`` field (absent pre-VMA, where ``get_aval`` serves)."""
+    import jax
+
+    if hasattr(jax, "typeof"):
+        return jax.typeof(x)
+    return jax.core.get_aval(x)

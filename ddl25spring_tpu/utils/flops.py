@@ -10,9 +10,12 @@ peak so drivers can print an MFU line next to samples/sec.
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import jax
+
+_log = logging.getLogger(__name__)
 
 # Public per-chip dense bf16 peaks (FLOP/s).  Matched by prefix against
 # ``jax.Device.device_kind`` (e.g. "TPU v5 lite" -> v5e).  Longest prefix
@@ -34,7 +37,11 @@ PEAK_BF16_FLOPS: dict[str, float] = {
 def chip_peak_flops(device: jax.Device | None = None) -> float | None:
     """Per-chip bf16 peak FLOP/s for ``device`` (default: ``jax.devices()[0]``),
     or None when the platform has no meaningful MXU peak (CPU simulation)."""
-    d = device if device is not None else jax.devices()[0]
+    try:
+        d = device if device is not None else jax.devices()[0]
+    except Exception as e:  # backend init can fail (dead TPU tunnel)
+        _log.warning("no default device for peak-FLOPs lookup (%s)", e)
+        return None
     if d.platform != "tpu":
         return None
     kind = getattr(d, "device_kind", "") or ""
@@ -50,7 +57,9 @@ def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> float | None:
     program (fwd + bwd + optimizer — everything inside the jit boundary).
 
     Hits the jit cache when the function was already called with these
-    shapes.  Returns None where the backend exposes no cost model.
+    shapes.  Returns None where the backend exposes no cost model — with a
+    one-line warning naming why, so an MFU-less bench line is explained in
+    the log instead of silently blank.
     """
     try:
         compiled = jitted_fn.lower(*args, **kwargs).compile()
@@ -58,8 +67,21 @@ def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> float | None:
         if isinstance(ca, (list, tuple)):  # older jax returns [dict]
             ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:
+        if flops <= 0:
+            _log.warning(
+                "XLA cost analysis returned no flops count for %s; "
+                "MFU will be reported as None",
+                getattr(jitted_fn, "__name__", jitted_fn),
+            )
+            return None
+        return flops
+    except Exception as e:  # noqa: BLE001 — degrade to None, but say why
+        _log.warning(
+            "XLA cost analysis unavailable (%s: %s); MFU will be "
+            "reported as None",
+            type(e).__name__,
+            e,
+        )
         return None
 
 

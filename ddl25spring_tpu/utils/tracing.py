@@ -71,11 +71,41 @@ class StepTimer:
                 self.times.append(now - self._last)
         self._last = now
 
-    @property
-    def mean_step_s(self) -> float:
+    def _require_times(self) -> list[float]:
         if not self.times:
             raise ValueError("no timed steps yet (all in warmup?)")
-        return sum(self.times) / len(self.times)
+        return self.times
+
+    @property
+    def mean_step_s(self) -> float:
+        times = self._require_times()
+        return sum(times) / len(times)
+
+    def percentile(self, q: float) -> float:
+        """q-th percentile (0-100) of the recorded step intervals."""
+        times = sorted(self._require_times())
+        if len(times) == 1:
+            return times[0]
+        # linear interpolation between closest ranks (numpy default)
+        pos = (len(times) - 1) * q / 100.0
+        lo = int(pos)
+        hi = min(lo + 1, len(times) - 1)
+        return times[lo] + (times[hi] - times[lo]) * (pos - lo)
+
+    @property
+    def p50_step_s(self) -> float:
+        return self.percentile(50)
+
+    @property
+    def p95_step_s(self) -> float:
+        return self.percentile(95)
+
+    @property
+    def min_step_s(self) -> float:
+        return min(self._require_times())
 
     def steps_per_sec(self) -> float:
-        return 1.0 / self.mean_step_s
+        """Steady-state rate from the MEDIAN interval: one GC pause or
+        host hiccup in the window must not skew a bench line (the mean
+        remains available as ``mean_step_s``)."""
+        return 1.0 / self.p50_step_s

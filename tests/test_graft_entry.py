@@ -5,8 +5,10 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 import __graft_entry__
+from ddl25spring_tpu.utils.compat import HAS_VMA
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -17,6 +19,11 @@ def test_entry_compiles_and_runs():
     assert loss == loss and loss > 0  # finite, positive
 
 
+@pytest.mark.skipif(
+    not HAS_VMA,
+    reason="the dryrun's pipeline workloads need VMA-typed shard_map "
+    "(lax.pcast) for their grad paths; this jax predates it",
+)
 def test_dryrun_multichip_fresh_subprocess():
     """Simulate the driver: run dryrun_multichip in a fresh interpreter
     WITHOUT conftest's platform forcing — dryrun_multichip itself must

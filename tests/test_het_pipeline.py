@@ -19,7 +19,19 @@ from ddl25spring_tpu.parallel.het_pipeline import (
     make_het_pipeline_loss,
     make_het_pipeline_train_step,
 )
+from ddl25spring_tpu.utils.compat import HAS_VMA
 from ddl25spring_tpu.utils.mesh import make_mesh
+
+# Forward passes through the het pipeline run on any jax (pinned by the
+# loss-equality test below and by tests/test_obs.py).  The GRAD path does
+# not: pre-VMA jax's experimental shard_map mis-stages the transposed
+# program (_SpecError on a scalar cotangent) for the scan-over-ppermute
+# schedule, so gradient/train tests need the VMA-typed shard_map.
+needs_vma_grad = pytest.mark.skipif(
+    not HAS_VMA,
+    reason="pipeline grad path needs VMA-typed shard_map (lax.pcast); "
+    "this jax's experimental shard_map mis-transposes the schedule",
+)
 
 W = 8  # narrow net: CPU-fast, same structure
 S0 = ResNet18Stage0(width=W)
@@ -69,6 +81,7 @@ def test_het_pipeline_loss_equals_serial(setup, microbatches, devices8):
     np.testing.assert_allclose(l_pipe, l_serial, rtol=1e-5)
 
 
+@needs_vma_grad
 def test_het_pipeline_grads_equal_serial(setup, devices8):
     params, x, y = setup
     mesh = make_mesh(devices8[:2], stage=2)
@@ -84,6 +97,7 @@ def test_het_pipeline_grads_equal_serial(setup, devices8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@needs_vma_grad
 def test_het_pipeline_dp_pp_trains(setup, devices8):
     """DPxPP: 2-way data x 2-stage pipeline on 4 devices; loss decreases."""
     params, x, y = setup
@@ -108,6 +122,7 @@ def test_het_pipeline_dp_pp_trains(setup, devices8):
 # ---------------------------------------------------------- sharded params
 
 
+@needs_vma_grad
 def test_sharded_het_pipeline_equals_replicated(setup, devices8):
     """The stage-SHARDED variant (params packed [S, maxP] over the stage
     axis, each device materializing only its branch) must match the
@@ -150,6 +165,7 @@ def test_sharded_het_pipeline_equals_replicated(setup, devices8):
         )
 
 
+@needs_vma_grad
 def test_sharded_het_pipeline_param_memory(setup, devices8):
     """The point of sharding: per-device param bytes are max_s|p_s| (plus
     padding), not sum_s|p_s|.  Check the compiled argument footprint of the
@@ -188,6 +204,7 @@ def test_sharded_het_pipeline_param_memory(setup, devices8):
 
 
 @pytest.mark.parametrize("stages", [3, 4])
+@needs_vma_grad
 def test_het_pipeline_s3_s4_equals_serial(stages, devices8):
     """The S-generic ResNet stage split (round-5 lift of the S<=2 cap):
     the S-stage pipelined loss and grads equal the serial composition of
@@ -241,6 +258,7 @@ def test_het_pipeline_s3_s4_equals_serial(stages, devices8):
     )
 
 
+@needs_vma_grad
 def test_build_resnet_step_s3(devices8):
     """build_resnet_step at the reference flagship topology (dp=2, S=3):
     one step runs on a (data=2, stage=3) mesh and the loss is finite."""

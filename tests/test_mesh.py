@@ -4,6 +4,7 @@ single-process fallback."""
 import jax
 import pytest
 
+from ddl25spring_tpu.utils.compat import HAS_VMA
 from ddl25spring_tpu.utils.mesh import (
     make_hybrid_mesh,
     make_mesh,
@@ -49,6 +50,11 @@ def test_hybrid_mesh_forced_slices_layout(devices8):
         make_hybrid_mesh({"data": 3}, force_slices=3)
 
 
+@pytest.mark.skipif(
+    not HAS_VMA,
+    reason="pipeline grad path needs VMA-typed shard_map (lax.pcast); "
+    "this jax's experimental shard_map mis-transposes the schedule",
+)
 def test_hybrid_mesh_dp_over_dcn_pp_over_ici_trains(devices8):
     """One DP-over-DCN x PP-over-ICI train step on the simulated 2-slice
     mesh (VERDICT r3 #8): the flagship topology laid out so the gradient

@@ -1,0 +1,47 @@
+"""Summarize a telemetry run directory written by ``ddl25spring_tpu.obs``.
+
+    python tools/obs_report.py <run_dir>          # aligned table
+    python tools/obs_report.py <run_dir> --json   # machine-readable
+
+The run directory comes from any obs-instrumented driver — e.g.
+``python bench.py --smoke`` (CPU) or ``python bench.py --obs-dir DIR``
+(TPU).  Everything reported derives from host-side artifacts
+(``metrics.jsonl``, ``counters.json``, ``trace.json``); no
+``jax.profiler`` capture is involved anywhere on this path, so it works
+on tunneled TPU transports where device tracing hangs (RESULTS §6a).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ddl25spring_tpu.obs.report import format_report, summarize_run  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", help="directory holding metrics.jsonl (+ "
+                                    "counters.json / trace.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw summary dict as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        summary = summarize_run(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"no telemetry at {args.run_dir}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(format_report(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
