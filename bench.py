@@ -88,24 +88,59 @@ def probe_devices(timeout_s: float):
     return None, out.get("error", f"device init timed out after {timeout_s:.0f}s")
 
 
-def run_with_retries(argv, attempts: int, child_timeout_s: float) -> None:
+def attach_parent_telemetry(
+    record: dict, failures: list | None, compile_report: dict | None
+) -> dict:
+    """Merge the retry driver's structured failure records and the
+    pre-device compile report into a bench record's ``telemetry`` dict
+    (creating it when the child ran without ``--obs-dir``).  The result
+    is what makes a dead-device BENCH line machine-diagnosable: the
+    errors that killed each attempt AND the compile-time perf facts that
+    need no device at all."""
+    tel = record.get("telemetry")
+    if not isinstance(tel, dict):
+        tel = {"enabled": False}
+    if failures:
+        tel["retry_failures"] = failures
+    if compile_report is not None:
+        tel["compile_report"] = compile_report
+    record["telemetry"] = tel
+    return record
+
+
+def run_with_retries(
+    argv,
+    attempts: int,
+    child_timeout_s: float,
+    compile_report: dict | None = None,
+) -> None:
     """Re-exec the bench in fresh subprocesses until one prints a JSON
     line without an ``error`` field.  Fresh processes because a failed
     jax TPU backend init is sticky: once ``jax.devices()`` has raised,
     every later call in the same interpreter raises immediately, so
-    in-process retry can never recover from a transient tunnel outage."""
+    in-process retry can never recover from a transient tunnel outage.
+
+    Every failed attempt emits one structured JSONL record to stderr
+    (``{"record": "bench_retry_failure", attempt, error, backoff_s,
+    wall_s, rc}``) and the accumulated records ride the FINAL printed
+    line's ``telemetry.retry_failures`` — so a BENCH_r*.json capture of a
+    flaky/dead tunnel carries its own diagnosis instead of a bare 0.0
+    (the r01–r05 failure mode).  ``compile_report`` (computed by the
+    parent BEFORE any device contact) rides ``telemetry.compile_report``
+    on the same line, success or failure."""
     import subprocess
     import time
 
     backoff = (60.0, 120.0)
     last: dict = {}
+    failures: list[dict] = []
     for i in range(attempts):
         if i:
             delay = backoff[min(i - 1, len(backoff) - 1)]
-            print(f"bench attempt {i} failed; retrying in {delay:.0f}s "
-                  f"({attempts - i} attempts left)", file=sys.stderr)
             time.sleep(delay)
         env = dict(os.environ, DDL25_BENCH_CHILD="1")
+        t0 = time.perf_counter()
+        rc = None
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), *argv],
@@ -118,38 +153,54 @@ def run_with_retries(argv, attempts: int, child_timeout_s: float) -> None:
             sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
                              if isinstance(e.stderr, bytes)
                              else (e.stderr or ""))
+            err = (f"attempt {i + 1}: bench subprocess exceeded "
+                   f"{child_timeout_s:.0f}s and was killed")
             last = {
                 "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
                 "value": 0.0, "unit": "samples/sec/chip",
                 "vs_baseline": 0.0,
-                "error": f"attempt {i + 1}: bench subprocess exceeded "
-                         f"{child_timeout_s:.0f}s and was killed",
+                "error": err,
             }
-            continue
-        sys.stderr.write(r.stderr)
-        parsed = None
-        for line in reversed(r.stdout.strip().splitlines()):
-            try:
-                candidate = json.loads(line)
-            except json.JSONDecodeError:
-                continue
+            parsed = None
+        else:
+            rc = r.returncode
+            sys.stderr.write(r.stderr)
             # only dict lines are bench records; a stray printable (bare
-            # number, quoted string) must not crash the retry driver
-            if isinstance(candidate, dict):
-                parsed = candidate
-                break
-        if parsed is not None and "error" not in parsed:
-            print(json.dumps(parsed))
-            return
-        last = parsed or {
-            "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
-            "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
-            "error": f"attempt {i + 1}: bench subprocess exited "
-                     f"rc={r.returncode} with no JSON line",
+            # number, quoted string) must not crash the driver
+            from ddl25spring_tpu.obs.compile_report import last_json_dict_line
+
+            parsed = last_json_dict_line(r.stdout)
+            if parsed is not None and "error" not in parsed:
+                print(json.dumps(
+                    attach_parent_telemetry(parsed, failures, compile_report)
+                ))
+                return
+            last = parsed or {
+                "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
+                "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
+                "error": f"attempt {i + 1}: bench subprocess exited "
+                         f"rc={rc} with no JSON line",
+            }
+        # structured JSONL failure record (replaces the old bare print):
+        # machine-diagnosable on stderr now, and carried in the final
+        # line's telemetry below
+        next_backoff = (
+            backoff[min(i, len(backoff) - 1)] if i + 1 < attempts else 0.0
+        )
+        rec = {
+            "record": "bench_retry_failure",
+            "attempt": i + 1,
+            "attempts_left": attempts - i - 1,
+            "error": str(last.get("error", "unknown")),
+            "rc": rc,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "backoff_s": next_backoff,
         }
+        failures.append(rec)
+        print(json.dumps(rec), file=sys.stderr)
     last.setdefault("error", "unknown")
     last["error"] = f"exhausted {attempts} attempts; last: {last['error']}"
-    print(json.dumps(last))
+    print(json.dumps(attach_parent_telemetry(last, failures, compile_report)))
 
 
 def fedavg_secondary(n_rounds: int = 10) -> dict:
@@ -228,6 +279,12 @@ def main(argv=None) -> None:
                     help="CPU smoke run with telemetry: single-device DP, "
                          "tiny dataset/steps, no FedAvg; writes "
                          "--obs-dir (default runs/bench_smoke)")
+    ap.add_argument("--compile-report", action="store_true",
+                    help="force the pre-device compile report on CPU runs "
+                         "(the accelerator path always computes it; see "
+                         "ddl25spring_tpu/obs/compile_report.py)")
+    ap.add_argument("--no-compile-report", action="store_true",
+                    help="skip the compile report on the accelerator path")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -241,10 +298,32 @@ def main(argv=None) -> None:
         os.environ.setdefault("DDL25_BENCH_NTRAIN", "512")
 
     on_cpu = args.cpu or args.force_cpu_devices
-    if not on_cpu and os.environ.get("DDL25_BENCH_CHILD") != "1":
+    is_child = os.environ.get("DDL25_BENCH_CHILD") == "1"
+
+    # compile-time analytics BEFORE any device contact: lowered on a fake
+    # CPU mesh in a fresh subprocess, so the report exists even when the
+    # TPU tunnel is dead (the r01-r05 failure mode) and never pollutes
+    # this process's backend state.  Parent path always; CPU runs opt in.
+    compile_report = None
+    # the child never recomputes: the parent did, once, and attaches it
+    want_cr = not is_child and (
+        args.compile_report or (not on_cpu and not args.no_compile_report)
+    )
+    if want_cr:
+        from ddl25spring_tpu.obs.compile_report import (
+            bench_compile_report_subprocess,
+            write_compile_report,
+        )
+
+        compile_report = bench_compile_report_subprocess()
+        if args.obs_dir:
+            write_compile_report(args.obs_dir, compile_report)
+
+    if not on_cpu and not is_child:
         run_with_retries(
             argv if argv is not None else sys.argv[1:],
             args.attempts, args.child_timeout,
+            compile_report=compile_report,
         )
         return
 
@@ -256,11 +335,14 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", "cpu")
     devices, err = probe_devices(args.probe_timeout)
     if devices is None:
-        print(json.dumps({
+        record = {
             "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
             "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
             "error": f"accelerator unreachable: {err}",
-        }))
+        }
+        if compile_report is not None:
+            attach_parent_telemetry(record, None, compile_report)
+        print(json.dumps(record))
         return
 
     import time
@@ -429,6 +511,8 @@ def main(argv=None) -> None:
     peak = chip_peak_flops(meta["device"])
 
     telemetry = {"enabled": False}
+    if compile_report is not None:
+        telemetry["compile_report"] = compile_report
     if lg is not None:
         # supplementary header: facts only known after the timed phases
         # (summarize_run merges header records in order)
@@ -446,6 +530,10 @@ def main(argv=None) -> None:
         s = summarize_run(args.obs_dir)
         telemetry = {
             "enabled": True,
+            **(
+                {"compile_report": compile_report}
+                if compile_report is not None else {}
+            ),
             "run_dir": args.obs_dir,
             "bubble_fraction": s.get("bubble_fraction"),
             "tick_interval_s_p50": s.get("tick_interval_s_p50"),

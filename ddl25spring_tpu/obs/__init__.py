@@ -46,6 +46,10 @@ from ddl25spring_tpu.obs.spans import (
 )
 from ddl25spring_tpu.obs.state import enable, enabled, scoped
 
+# compile-time analytics (obs/xla_analytics.py, obs/compile_report.py) are
+# imported lazily by their consumers — they pull in the parallel stack and
+# must not tax `import ddl25spring_tpu.obs` on the hot bench path.
+
 __all__ = [
     "CounterSet",
     "MetricsLogger",

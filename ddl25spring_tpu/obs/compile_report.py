@@ -1,0 +1,251 @@
+"""Build multi-strategy compile reports (the no-TPU perf instrument).
+
+Front end over :mod:`ddl25spring_tpu.obs.xla_analytics`: compile every
+registered parallel strategy (or the bench workload itself) on a fake
+CPU mesh and collect the per-strategy reports — collective inventory,
+peak-HBM estimate, FLOP totals, roofline projections, and signature
+violations — into one JSON document.  Three consumers:
+
+- ``bench.py`` attaches the bench-workload report to its BENCH line's
+  ``telemetry`` dict *before* probing the device, so a dead-TPU run
+  still yields analyzable perf data (the r01–r05 failure mode);
+- ``tools/comms_report.py`` renders the human table and gates CI on
+  signature drift;
+- ``obs/report.py`` folds a ``compile_report.json`` found in a run
+  directory into the telemetry summary.
+
+Run directly (prints JSON to stdout; CPU-only, sets its own fake device
+count)::
+
+    python -m ddl25spring_tpu.obs.compile_report --strategies dp,zero3
+    python -m ddl25spring_tpu.obs.compile_report --bench
+
+A strategy that cannot trace/compile on the running jax (e.g. the
+homogeneous-pipeline grad path pre-VMA) reports ``{"error": ...}`` for
+its entry and never takes the others down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any
+
+COMPILE_REPORT_BASENAME = "compile_report.json"
+
+# strategies cheap enough to compile on every CI run, in report order
+DEFAULT_STRATEGIES = (
+    "dp", "zero1", "zero2", "zero3",
+    "pipeline", "het_pipeline", "tp", "sp", "ep",
+)
+
+
+def build_compile_report(
+    strategies: tuple[str, ...] | list[str] | None = None,
+    mesh_sizes: tuple[int, ...] | None = None,
+) -> dict[str, Any]:
+    """Compile + analyze each named strategy (default: all registered).
+    ``mesh_sizes`` applies to every strategy (positional onto its axis
+    names); None takes each strategy's default mesh."""
+    import jax
+
+    from ddl25spring_tpu.obs import xla_analytics
+
+    report: dict[str, Any] = {
+        "record": "compile_report",
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "strategies": {},
+    }
+    for name in strategies or DEFAULT_STRATEGIES:
+        report["strategies"][name] = xla_analytics.compile_strategy(
+            name, mesh_sizes
+        )
+    return report
+
+
+def bench_compile_report(
+    dp: int = 2,
+    stages: int = 2,
+    microbatches: int = 2,
+    per_chip_batch: int = 64,
+) -> dict[str, Any]:
+    """Compile report for the BASELINE.json bench workload itself: the
+    ResNet-18/CIFAR-10 train steps ``benchmarks.build_resnet_step``
+    produces, lowered on a fake CPU mesh at a REDUCED batch (collective
+    structure and grad bytes are batch-invariant for DP; compile time is
+    not).  Two entries: ``bench-dp`` (pure DP) and ``bench-dppp`` (the
+    DPxPP het pipeline — on pre-VMA jax its grad path cannot trace, and
+    the entry degrades to an error string, which is itself signal)."""
+    import jax
+
+    from ddl25spring_tpu.obs import xla_analytics
+
+    devices = jax.devices("cpu")
+    report: dict[str, Any] = {
+        "record": "compile_report",
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "note": f"bench workload lowered at per_chip_batch={per_chip_batch} "
+                "(reduced for CPU compile time; DP collective payloads are "
+                "batch-invariant)",
+        "strategies": {},
+    }
+
+    def entry(name, dp_n, S, M):
+        from ddl25spring_tpu.benchmarks import build_resnet_step
+
+        n = dp_n * S
+        if len(devices) < n:
+            return {"strategy": name,
+                    "error": f"needs {n} CPU devices, have {len(devices)}"}
+        batch = per_chip_batch * n
+        try:
+            step, params, opt_state, meta = build_resnet_step(
+                devices[:n], dp_n, S, M, batch, instrument=False
+            )
+            import jax.numpy as jnp
+
+            raw = (
+                jnp.zeros((batch, 32, 32, 3), jnp.uint8),
+                jnp.zeros((batch,), jnp.int32),
+            )
+            compiled = step.lower(params, opt_state, raw).compile()
+            mesh = meta["mesh"]
+            r = xla_analytics.analyze_compiled(compiled, mesh, meta={
+                "layout": meta["layout"],
+                "topology": meta["topology"],
+                "n_chips": meta["n_chips"],
+                "batch": batch,
+            })
+            r["strategy"] = name
+            r["mesh"] = {
+                ax: int(s)
+                for ax, s in zip(mesh.axis_names, mesh.devices.shape)
+            }
+            r["lowered"] = "train_step"
+            return r
+        except Exception as e:  # noqa: BLE001 — degrade per entry
+            return {"strategy": name, "error": f"{type(e).__name__}: {e}"}
+
+    report["strategies"]["bench-dp"] = entry("bench-dp", dp, 1, 1)
+    report["strategies"]["bench-dppp"] = entry(
+        "bench-dppp", dp, stages, microbatches
+    )
+    return report
+
+
+def write_compile_report(run_dir: str, report: dict[str, Any]) -> str:
+    """Persist a report as ``<run_dir>/compile_report.json`` (the file
+    ``obs/report.py`` and ``tools/obs_report.py`` pick up)."""
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, COMPILE_REPORT_BASENAME)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    return path
+
+
+def bench_compile_report_subprocess(
+    timeout_s: float = 600.0,
+) -> dict[str, Any]:
+    """Run :func:`bench_compile_report` in a fresh CPU-only subprocess.
+
+    ``bench.py``'s parent driver cannot compute the report in-process:
+    its jax must stay free to dial the TPU backend, while the report
+    needs ``JAX_PLATFORMS=cpu`` plus a multi-device fake-host flag — both
+    of which are interpreter-start decisions.  A subprocess gives the
+    report its own interpreter and keeps a report-side crash from
+    costing the bench."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DDL25_OBS="")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4"
+        ).strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ddl25spring_tpu.obs.compile_report",
+             "--bench"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"compile-report subprocess exceeded {timeout_s:.0f}s"}
+    if r.returncode != 0:
+        return {"error": "compile-report subprocess failed rc="
+                         f"{r.returncode}: {(r.stderr or '')[-500:]}"}
+    parsed = last_json_dict_line(r.stdout)
+    if parsed is None:
+        return {"error": "compile-report subprocess printed no JSON"}
+    return parsed
+
+
+def last_json_dict_line(stdout: str) -> dict[str, Any] | None:
+    """The last stdout line that parses as a JSON *dict* (the driver
+    contract both the bench children and the compile-report subprocess
+    print) — stray printables and non-dict JSON are skipped.  Shared by
+    ``bench.py``'s retry driver and the subprocess wrapper above."""
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            return parsed
+    return None
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    import jax
+
+    # env alone is too late on images whose sitecustomize registers a
+    # TPU plugin at interpreter start (the exact no-accelerator scenario
+    # this tool serves); the config call forces CPU regardless
+    jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--strategies", default=None,
+                    help="comma-separated strategy names "
+                         f"(default: {','.join(DEFAULT_STRATEGIES)})")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh sizes like 2x4, positional onto each "
+                         "strategy's axis names")
+    ap.add_argument("--bench", action="store_true",
+                    help="report on the bench workload (ResNet DP / DPxPP) "
+                         "instead of the strategy registry")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="also write DIR/compile_report.json")
+    args = ap.parse_args(argv)
+
+    mesh_sizes = (
+        tuple(int(x) for x in args.mesh.lower().split("x"))
+        if args.mesh else None
+    )
+    if args.bench:
+        report = bench_compile_report()
+    else:
+        names = (
+            tuple(s.strip() for s in args.strategies.split(",") if s.strip())
+            if args.strategies else None
+        )
+        report = build_compile_report(names, mesh_sizes)
+    if args.out:
+        write_compile_report(args.out, report)
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    # CPU-only, multi-device fake host — decided before any backend init
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.exit(main())

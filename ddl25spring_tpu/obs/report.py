@@ -151,6 +151,23 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
             n: sum(1 for e in evs if e["name"] == n)
             for n in sorted({e["name"] for e in evs})
         }
+
+    # compile-time analytics, when a bench/CLI run dropped its report here
+    # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
+    # compiled collectives/HBM/MFU-projection below, one run dir
+    from ddl25spring_tpu.obs.compile_report import COMPILE_REPORT_BASENAME
+
+    crpath = os.path.join(run_dir, COMPILE_REPORT_BASENAME)
+    if os.path.exists(crpath):
+        try:
+            with open(crpath) as f:
+                out["compile_report"] = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            # a truncated report (killed mid-write) must not cost the
+            # measured runtime metrics in the same run dir
+            out["compile_report"] = {
+                "error": f"unreadable {COMPILE_REPORT_BASENAME}: {e}"
+            }
     return out
 
 
@@ -243,4 +260,44 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("host spans (trace.json — load in Perfetto):")
         for n, cnt in summary["span_counts"].items():
             lines.append(f"  {n:<40} x{cnt}")
+
+    cr = summary.get("compile_report")
+    if cr:
+        lines.append("")
+        lines.append(
+            "compile analytics (compile_report.json — no device needed; "
+            "see tools/comms_report.py):"
+        )
+        if cr.get("error"):
+            lines.append(f"  {cr['error']}")
+        for name, r in cr.get("strategies", {}).items():
+            if "error" in r:
+                lines.append(f"  {name:<14} FAILED: {str(r['error'])[:90]}")
+                continue
+            totals = r.get("collectives", {}).get("totals", {})
+            coll = "  ".join(
+                f"{k} x{t['count']} ({t['result_bytes'] / 1024:.1f} KiB)"
+                for k, t in sorted(totals.items())
+            ) or "no collectives"
+            lines.append(f"  {name:<14} {coll}")
+            mem = r.get("memory") or {}
+            proj = (r.get("projection") or {}).get("TPU v4")
+            bits = []
+            if mem.get("peak_hbm_bytes") is not None:
+                bits.append(
+                    f"peak HBM est {mem['peak_hbm_bytes'] / 2**20:.1f} MiB"
+                )
+            if r.get("flops"):
+                bits.append(f"flops/step {r['flops']:.3g}")
+            if proj:
+                bits.append(
+                    f"projected MFU(v4) {proj['projected_mfu']:.3f} "
+                    f"[{proj['bound']}-bound]"
+                )
+            if bits:
+                lines.append(f"  {'':<14} {'  '.join(bits)}")
+            viols = r.get("signature_violations")
+            if viols:
+                for v in viols:
+                    lines.append(f"  {'':<14} VIOLATION: {v}")
     return "\n".join(lines)

@@ -29,6 +29,7 @@ from ddl25spring_tpu.parallel.tp import (
 )
 from ddl25spring_tpu.parallel.zero import (
     make_zero_dp_train_step,
+    make_zero_partitioned_train_step,
     zero_clip_by_global_norm,
     zero_shard_params,
     zero_unshard_params,
@@ -55,6 +56,7 @@ __all__ = [
     "make_tp_train_step",
     "shard_tp_params",
     "make_zero_dp_train_step",
+    "make_zero_partitioned_train_step",
     "zero_clip_by_global_norm",
     "zero_shard_params",
     "zero_unshard_params",

@@ -180,3 +180,73 @@ def stack_opt_state(opt_state, n: int):
     """Replicate an optax state into the stacked ``[n, ...]`` layout used by
     :func:`make_dp_weight_avg_step`."""
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + jnp.shape(x)), opt_state)
+
+
+def _tiny_mlp_workload(n_shards: int):
+    """The minimal DP workload the compile-time analytics lower: a 2-layer
+    MLP regression step whose gradient tree has a known byte size (shared
+    shape with :func:`ddl25spring_tpu.parallel.zero.describe` so the
+    DP/ZeRO signatures compare like for like)."""
+    d_in, d_h, d_out = 16, 32, 4
+    params = {
+        "w1": jnp.zeros((d_in, d_h), jnp.float32),
+        "b1": jnp.zeros((d_h,), jnp.float32),
+        "w2": jnp.zeros((d_h, d_out), jnp.float32),
+    }
+
+    def loss_fn(p, batch, key):
+        del key
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    batch = (
+        jnp.zeros((8 * n_shards, d_in), jnp.float32),
+        jnp.zeros((8 * n_shards, d_out), jnp.float32),
+    )
+    param_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
+    )
+    return params, loss_fn, batch, param_bytes
+
+
+def describe(mesh: Mesh, axis: str = "data"):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    lowerable DP train step + example inputs + the analytic collective
+    signature.
+
+    Plain gradient-aggregation DP's compiled signature is the tightest of
+    all strategies: the ONLY cross-device traffic is the gradient
+    all-reduce — total all-reduce payload == grad bytes (+ scalar loss
+    reductions), every group over the data axis, and no other collective
+    kind at all.  A stray all-gather here means someone broke the
+    replicated-params invariant.
+    """
+    n = mesh.shape[axis]
+    params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
+    tx = optax.sgd(0.1)
+    step = make_dp_train_step(
+        loss_fn, tx, mesh, axis=axis, per_shard_rng=False, instrument=False
+    )
+    return {
+        "fn": step,
+        "args": (params, tx.init(params), batch, jax.random.PRNGKey(0)),
+        "lowered": "train_step",
+        "meta": {
+            "param_bytes": param_bytes,
+            "grad_bytes": param_bytes,
+            "n_param_leaves": len(jax.tree.leaves(params)),
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "all-reduce": {
+                "min_bytes": param_bytes,
+                "max_bytes": param_bytes + 256,
+                "axes": [axis],
+            },
+            "forbidden": [
+                "all-gather", "reduce-scatter", "collective-permute",
+                "all-to-all",
+            ],
+        },
+    }

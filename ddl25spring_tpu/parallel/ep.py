@@ -299,3 +299,47 @@ def shard_moe_params(p: Params, mesh: Mesh, axis: str = "expert") -> Params:
         "w_up": NamedSharding(mesh, P(axis)),
         "w_down": NamedSharding(mesh, P(axis)),
     })
+
+
+def describe(mesh: Mesh, axis: str = "expert"):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    expert-parallel MoE layer under ``value_and_grad`` + its analytic
+    collective signature.
+
+    EP is the only strategy whose defining collective is ``all-to-all``:
+    exactly two per forward (dispatch + combine) and two more in the
+    backward (an all_to_all transposes to the inverse all_to_all), every
+    one over the expert axis.  A reduce-scatter or collective-permute
+    here means the dispatch stopped being a pure bucket exchange.
+    """
+    cfg_E = mesh.shape[axis]  # experts == axis size: E/ep == 1 per device
+    D, F, T = 16, 32, 16 * cfg_E
+    params = init_moe_params(jax.random.PRNGKey(0), D, F, cfg_E)
+    params = shard_moe_params(params, mesh, axis)
+    moe = make_ep_moe_fn(mesh, axis)
+
+    def scalar_loss(p, x):
+        y, aux = moe(p, x)
+        return jnp.mean(y**2) + aux
+
+    fn = jax.jit(jax.value_and_grad(scalar_loss))
+    x = jnp.zeros((T, D), jnp.float32)
+    return {
+        "fn": fn,
+        "args": (params, x),
+        "lowered": "value_and_grad",
+        "meta": {
+            "n_experts": cfg_E,
+            "tokens": T,
+            "dmodel": D,
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "all-to-all": {
+                "min_count": 2,      # dispatch + combine (fwd); bwd may CSE
+                "max_count": 4,
+                "axes": [axis],
+            },
+            "forbidden": ["collective-permute", "reduce-scatter"],
+        },
+    }

@@ -217,6 +217,77 @@ def make_het_pipeline_train_step(
     return step
 
 
+def describe(
+    mesh: Mesh,
+    num_microbatches: int = 4,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: a
+    minimal 2-stage heterogeneous pipeline (two dense stages with
+    *different* boundary widths — the property the flat-buffer packing
+    exists for) + its analytic collective signature: one
+    ``collective-permute`` of the padded boundary buffer per tick,
+    ``M + S - 1`` ticks per direction (forward-only pre-VMA, where the
+    grad path of the scan-over-ppermute schedule cannot be transposed —
+    same gating as ``tests/test_het_pipeline.py::needs_vma_grad``)."""
+    from ddl25spring_tpu.utils.compat import HAS_VMA
+
+    if data_axis is None and "data" in mesh.axis_names:
+        data_axis = "data"
+    S = mesh.shape[stage_axis]
+    if S != 2:
+        raise ValueError(f"het_pipeline describe() ships 2 stages, got {S}")
+    M = num_microbatches
+    dp = mesh.shape[data_axis] if data_axis else 1
+    mb, d_in, d_mid, d_out = 2, 8, 16, 4
+    params = (
+        {"w": jnp.zeros((d_in, d_mid), jnp.float32)},
+        {"w": jnp.zeros((d_mid, d_out), jnp.float32)},
+    )
+    stage_fns = [
+        lambda p, x: jnp.tanh(x @ p["w"]),
+        lambda p, x: x @ p["w"],
+    ]
+    loss = make_het_pipeline_loss(
+        stage_fns,
+        lambda out, b: jnp.mean((out - b["y"]) ** 2),
+        (mb, d_in), [(mb, d_mid), (mb, d_out)],
+        mesh, M, stage_axis=stage_axis, data_axis=data_axis,
+        instrument=False,
+    )
+    B = M * mb * dp
+    batch = {
+        "x": jnp.zeros((B, d_in), jnp.float32),
+        "y": jnp.zeros((B, d_out), jnp.float32),
+    }
+    fn = jax.jit(jax.value_and_grad(loss) if HAS_VMA else loss)
+    T = M + S - 1
+    hops = 2 * T if HAS_VMA else T
+    buf_bytes = mb * max(d_mid, d_out) * 4  # padded flat boundary, f32
+    return {
+        "fn": fn,
+        "args": (params, batch),
+        "lowered": "value_and_grad" if HAS_VMA else "loss",
+        "meta": {
+            "num_stages": S,
+            "num_microbatches": M,
+            "ticks": T,
+            "boundary_bytes": buf_bytes,
+            "bubble_fraction": (S - 1) / T,
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "collective-permute": {
+                "min_count": hops,
+                "max_count": hops + T,
+                "axes": [stage_axis],
+            },
+            "forbidden": ["all-to-all", "reduce-scatter", "all-gather"],
+        },
+    }
+
+
 # ------------------------------------------------------------------ sharded
 
 

@@ -1487,6 +1487,74 @@ def shard_staged_params(
     return jax.device_put(params, shardings)
 
 
+def describe(
+    mesh: Mesh,
+    num_microbatches: int = 4,
+    stage_axis: str = "stage",
+    data_axis: str | None = None,
+):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    lowerable GPipe program + example inputs + the analytic collective
+    signature.
+
+    The GPipe schedule's signature is ONE ``collective-permute`` site
+    inside the tick scan, executed ``M + S - 1`` times per forward pass
+    (XLA pins the trip count on the optimized while op) — i.e.
+    "microbatches + stages - 1 boundary hops per direction".  On jax with
+    VMA-typed shard_map the hook lowers ``value_and_grad`` (the scan
+    transpose replays the permutes in reverse, doubling the executions);
+    pre-VMA jax mis-transposes the schedule (see ``tests/test_pipeline``'s
+    skip), so there the hook lowers the forward loss only and the
+    expected counts halve — ``meta["lowered"]`` says which you got.
+    """
+    from ddl25spring_tpu.utils.compat import HAS_VMA
+
+    if data_axis is None and "data" in mesh.axis_names:
+        data_axis = "data"  # --mesh 2x2 style requests: ride DP x PP
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=4, ctx_size=16,
+        dtype="float32",
+    )
+    S = mesh.shape[stage_axis]
+    M = num_microbatches
+    dp = mesh.shape[data_axis] if data_axis else 1
+    mb = 2
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    staged = llama.split_blocks_for_stages(params, S)
+    loss = make_pipeline_loss(
+        cfg, mesh, M, stage_axis, data_axis, instrument=False
+    )
+    tokens = jnp.zeros((M * mb * dp, cfg.ctx_size), jnp.int32)
+    fn = jax.jit(jax.value_and_grad(loss) if HAS_VMA else loss)
+    T = M + S - 1
+    hops = 2 * T if HAS_VMA else T  # transpose replays the ring in reverse
+    boundary_bytes = mb * cfg.ctx_size * cfg.dmodel * 4  # f32 activations
+    return {
+        "fn": fn,
+        "args": (staged, tokens),
+        "lowered": "value_and_grad" if HAS_VMA else "loss",
+        "meta": {
+            "num_stages": S,
+            "num_microbatches": M,
+            "ticks": T,
+            "boundary_bytes": boundary_bytes,
+            "bubble_fraction": (S - 1) / T,
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "collective-permute": {
+                "min_count": hops,
+                # fusion may not merge every hop; a stray EXTRA permute
+                # per tick (e.g. an accidentally stage-varying carry)
+                # would exceed this
+                "max_count": hops + T,
+                "axes": [stage_axis],
+            },
+            "forbidden": ["all-to-all", "reduce-scatter"],
+        },
+    }
+
+
 def make_grad_accum_step(
     loss_fn: Callable, tx: optax.GradientTransformation, num_microbatches: int
 ):

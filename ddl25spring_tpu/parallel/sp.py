@@ -390,3 +390,56 @@ def make_sp_train_step(
         return params, opt_state, loss
 
     return step
+
+
+def describe(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    data_axis: str | None = None,
+    mode: str = "ring",
+):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    lowerable ring-SP train step + the analytic collective signature.
+
+    Ring attention's compiled fingerprint is ``collective-permute``
+    inside a while loop whose trip count is the seq-axis size — one KV
+    rotation per ring step, per layer, forward and backward — plus the
+    one boundary-token hop of the causal loss.  All permutes group over
+    the seq axis; all-to-all appearing under ``mode="ring"`` means
+    someone swapped in the Ulysses path without saying so.
+    """
+    if data_axis is None and "data" in mesh.axis_names:
+        data_axis = "data"
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32",
+    )
+    n = mesh.shape[seq_axis]
+    dp = mesh.shape[data_axis] if data_axis else 1
+    tx = optax.sgd(1e-2)
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    step = make_sp_train_step(cfg, tx, mesh, seq_axis, data_axis, mode)
+    tokens = jnp.zeros((4 * dp, cfg.ctx_size), jnp.int32)
+    axes = [seq_axis] + ([data_axis] if data_axis else [])
+    # fwd: n ring steps x (k, v, pos) rotations per layer + 1 targets hop;
+    # bwd replays the ring (cotangent rotations) — floor at the fwd share
+    min_hops = cfg.n_layers * n
+    return {
+        "fn": step,
+        "args": (params, tx.init(params), tokens),
+        "lowered": "train_step",
+        "meta": {
+            "n_layers": cfg.n_layers,
+            "seq_shards": n,
+            "mode": mode,
+            "local_len": cfg.ctx_size // n,
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "collective-permute": {
+                "min_count": min_hops,
+                "axes": axes,
+            },
+            **({"forbidden": ["all-to-all"]} if mode == "ring" else {}),
+        },
+    }

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
-from ddl25spring_tpu.utils.compat import pcast, shard_map
+from ddl25spring_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl25spring_tpu.models import llama
@@ -277,3 +277,59 @@ def make_tp_train_step(
         return params, opt_state, loss
 
     return step
+
+
+def describe(
+    mesh: Mesh,
+    model_axis: str = "model",
+    data_axis: str | None = None,
+):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    lowerable Megatron-TP train step + the analytic collective signature.
+
+    TP's compiled traffic is all-reduce shaped: the two row-parallel
+    psums per block (fwd) and their column-side mirrors (bwd), plus the
+    vocab-sharded embed/loss assembly — every group strictly over the
+    model axis.  The load-bearing pin is the *absence* of
+    ``collective-permute`` (TP never ring-shifts) and that nothing
+    groups over any other axis: a collective that suddenly spans
+    ``data`` here means a replicated-invariant was broken.
+    """
+    if data_axis is None and "data" in mesh.axis_names:
+        data_axis = "data"
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32",
+    )
+    dp = mesh.shape[data_axis] if data_axis else 1
+    tx = optax.sgd(1e-2)
+    params = shard_tp_params(
+        llama.init_llama_params(jax.random.PRNGKey(0), cfg), mesh, model_axis
+    )
+    step = make_tp_train_step(cfg, tx, mesh, model_axis, data_axis)
+    tokens = jnp.zeros((4 * dp, cfg.ctx_size), jnp.int32)
+    axes = [model_axis] + ([data_axis] if data_axis else [])
+    # per-block psum payload: one [B, L, D] activation in fp32
+    act_bytes = 4 * dp * cfg.ctx_size * cfg.dmodel * 4
+    return {
+        "fn": step,
+        "args": (params, tx.init(params), tokens),
+        "lowered": "train_step",
+        "meta": {
+            "n_layers": cfg.n_layers,
+            "block_psum_bytes": act_bytes,
+            "shard_vocab": True,
+        },
+        "expected": {
+            "scalar_bytes": 64,
+            "all-reduce": {
+                # >= the 2 row-parallel psums per block fwd + their bwd
+                # mirrors (XLA may CSE some of the backward's, so the
+                # byte floor is the forward's share only)
+                "min_count": 4 * cfg.n_layers,
+                "axes": axes,
+                "min_bytes": 2 * cfg.n_layers * act_bytes,
+            },
+            "forbidden": ["collective-permute"],
+        },
+    }

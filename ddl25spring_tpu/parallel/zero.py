@@ -164,20 +164,7 @@ def make_zero_dp_train_step(
         # is not one of the [n, k] shard layouts (e.g. a transform carrying
         # its own matrix state) would be mis-sharded, so reject it loudly.
         shard_shapes = {jnp.shape(l) for l in jax.tree.leaves(param_shards)}
-
-        def spec_for(l):
-            if jnp.ndim(l) != 2:
-                return P()
-            if jnp.shape(l) not in shard_shapes:
-                raise ValueError(
-                    f"optimizer state carries a 2-D leaf of shape "
-                    f"{jnp.shape(l)} that matches no [n, k] param shard "
-                    f"{sorted(shard_shapes)}; this optax transform is not "
-                    "supported by the ZeRO sharding heuristic"
-                )
-            return P(axis)
-
-        state_specs = jax.tree.map(spec_for, opt_state)
+        state_specs = _opt_state_specs(opt_state, shard_shapes, axis)
 
         @partial(
             shard_map,
@@ -251,6 +238,241 @@ def make_zero_dp_train_step(
         return sharded_step(param_shards, opt_state, batch, key)
 
     return jax.jit(step)
+
+
+def _opt_state_specs(opt_state, shard_shapes: set, axis: str):
+    """PartitionSpecs for an optax state over the ``[n, k]`` shard layout:
+    param-shaped 2-D leaves shard over ``axis``, scalars/counters stay
+    replicated; any other 2-D leaf is rejected loudly (shared by the
+    ZeRO-3 step and the ZeRO-1/2 steps below)."""
+
+    def spec_for(leaf):
+        if jnp.ndim(leaf) != 2:
+            return P()
+        if jnp.shape(leaf) not in shard_shapes:
+            raise ValueError(
+                f"optimizer state carries a 2-D leaf of shape "
+                f"{jnp.shape(leaf)} that matches no [n, k] param shard "
+                f"{sorted(shard_shapes)}; this optax transform is not "
+                "supported by the ZeRO sharding heuristic"
+            )
+        return P(axis)
+
+    return jax.tree.map(spec_for, opt_state)
+
+
+def make_zero_partitioned_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    params_template,
+    axis: str = "data",
+    stage: int = 2,
+    per_shard_rng: bool = True,
+):
+    """ZeRO stage-1/2 trainstep: REPLICATED params, SHARDED optimizer
+    state (and, at stage 2, sharded reduced gradients).
+
+    Where :func:`make_zero_dp_train_step` (the stage-3/FSDP decomposition)
+    shards the parameters themselves, the classic ZeRO-1 and ZeRO-2
+    optimizer-sharding stages keep a full replica for the forward/backward
+    and partition only the *update*: each device owns rows ``i`` of every
+    leaf's padded ``[n, k]`` layout (the same layout as
+    :func:`zero_shard_params`, so ``opt_state = tx.init(zero_shard_params
+    (params, mesh))`` serves all three stages) and steps only its shard.
+    The two stages differ in how the summed gradient reaches the shard —
+    exactly the collective signature the compile-time analytics pin
+    (``tests/test_xla_analytics.py``):
+
+    - **stage 1**: ``all-reduce`` the full gradient (every device holds
+      the sum, as in plain DP), then slice the local rows — grad memory
+      stays O(P), comms = all_reduce(P) + all_gather(P);
+    - **stage 2**: ``reduce-scatter`` the packed gradient straight into
+      the local rows — grad memory O(P/n), comms = reduce_scatter(P) +
+      all_gather(P), the 2P-words total of a plain all_reduce.
+
+    Both finish by all-gathering the updated rows back into replicated
+    params (the partitioner inserts one all-gather per leaf for the
+    ``P(axis) -> P()`` resharding).  Update math is elementwise-optimizer
+    exact: identical to replicated DP + the same optax chain (asserted
+    against :func:`~ddl25spring_tpu.parallel.dp.make_dp_train_step` in
+    ``tests/test_zero.py``).  ``step(params, opt_state, batch, key)``
+    with ``params`` replicated and ``opt_state`` in the ``[n, k]``
+    sharded layout.
+    """
+    if stage not in (1, 2):
+        raise ValueError(f"stage must be 1 or 2, got {stage} "
+                         "(stage 3 is make_zero_dp_train_step)")
+    n = mesh.shape[axis]
+    treedef = jax.tree.structure(params_template)
+    metas = [
+        _leaf_meta(jnp.asarray(l), n)
+        for l in jax.tree.leaves(params_template)
+    ]
+    shard_shapes = {(n, k) for _, k in metas}
+
+    def pack(leaf, meta):
+        size, k = meta
+        flat = jnp.pad(leaf.reshape(-1), (0, n * k - size))
+        return flat.reshape(n, k)
+
+    def pack_tree(tree):
+        return treedef.unflatten([
+            pack(l, m) for l, m in zip(treedef.flatten_up_to(tree), metas)
+        ])
+
+    def step(params, opt_state, batch, key):
+        state_specs = _opt_state_specs(opt_state, shard_shapes, axis)
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), state_specs, P(axis), P()),
+            out_specs=(P(axis), state_specs, P()),
+        )
+        def sharded_step(params, ostate, b, key):
+            if per_shard_rng:
+                key = jax.random.fold_in(key, lax.axis_index(axis))
+            # local copies -> local grads on every jax vintage (an
+            # invariant param's autodiff would psum pre-emptively under
+            # VMA but not pre-VMA; the pcast makes both explicit)
+            lparams = pcast(params, axis, to="varying")
+            loss, grads = jax.value_and_grad(loss_fn)(lparams, b, key)
+            g2d = pack_tree(grads)
+            i = lax.axis_index(axis)
+            if stage == 1:
+                # sum everywhere (grad memory O(P)), then take our rows
+                g2d = jax.tree.map(lambda g: lax.pmean(g, axis), g2d)
+                gshard = jax.tree.map(
+                    lambda g: lax.dynamic_slice_in_dim(g, i, 1, 0), g2d
+                )
+            else:
+                # reduce straight into our rows (grad memory O(P/n))
+                gshard = jax.tree.map(
+                    lambda g: lax.psum_scatter(
+                        g, axis, scatter_dimension=0, tiled=True
+                    ) / n,
+                    g2d,
+                )
+            pshard = jax.tree.map(
+                lambda p: lax.dynamic_slice_in_dim(p, i, 1, 0),
+                pack_tree(params),
+            )
+            updates, ostate = tx.update(gshard, ostate, pshard)
+            new_shard = optax.apply_updates(pshard, updates)
+            return new_shard, ostate, lax.pmean(loss, axis)
+
+        new_shards, opt_state, loss = sharded_step(
+            params, opt_state, batch, key
+        )
+        # P(axis) -> P(): the partitioner lowers this resharding to ONE
+        # all-gather per leaf — the explicit gather half of the stage-1/2
+        # comms story
+        gathered = jax.lax.with_sharding_constraint(
+            new_shards, NamedSharding(mesh, P())
+        )
+        params = zero_unshard_params(gathered, params)
+        return params, opt_state, loss
+
+    return jax.jit(step)
+
+
+def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
+    """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
+    lowerable ZeRO train step (stage 1, 2, or 3) + example inputs + the
+    analytic collective signature.
+
+    The three stages are *distinguishable by their compiled collectives*
+    alone — the point of pinning them:
+
+    - stage 1: one all-reduce of the full (padded) grad bytes + one
+      all-gather of the updated param rows;
+    - stage 2: reduce-scatter (result = the 1/n grad shard) + the same
+      all-gather — no full-grad all-reduce anywhere;
+    - stage 3: per-leaf all-gathers of the padded params in the forward
+      and reduce-scatters out of the backward — no param-sized
+      all-reduce, no update-side gather.
+    """
+    from ddl25spring_tpu.parallel.dp import _tiny_mlp_workload
+
+    n = mesh.shape[axis]
+    params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
+    padded_bytes = sum(
+        n * _leaf_meta(leaf, n)[1] * jnp.result_type(leaf).itemsize
+        for leaf in jax.tree.leaves(params)
+    )
+    tx = optax.sgd(0.1)
+    shards = zero_shard_params(params, mesh, axis)
+    opt_state = tx.init(shards)
+    key = jax.random.PRNGKey(0)
+    n_leaves = len(jax.tree.leaves(params))
+    slack = 256
+    if stage == 3:
+        step = make_zero_dp_train_step(
+            loss_fn, tx, mesh, params, axis,
+            per_shard_rng=False, instrument=False,
+        )
+        args = (shards, opt_state, batch, key)
+        expected = {
+            "scalar_bytes": 64,
+            "all-gather": {
+                "min_bytes": padded_bytes,
+                "max_bytes": 2 * padded_bytes + slack,  # bwd may re-gather
+                "axes": [axis],
+            },
+            "reduce-scatter": {
+                "min_bytes": padded_bytes // n,
+                "max_bytes": padded_bytes // n + slack,
+                "axes": [axis],
+                "min_count": n_leaves,
+            },
+            # a param-sized all-reduce would mean the sharding collapsed
+            # back to replicated DP
+            "all-reduce": {"max_bytes": slack},
+            "forbidden": ["collective-permute", "all-to-all"],
+        }
+    else:
+        step = make_zero_partitioned_train_step(
+            loss_fn, tx, mesh, params, axis, stage=stage,
+            per_shard_rng=False,
+        )
+        args = (params, opt_state, batch, key)
+        expected = {
+            "scalar_bytes": 64,
+            "all-gather": {
+                "min_bytes": padded_bytes,
+                "max_bytes": padded_bytes + slack,
+                "axes": [axis],
+            },
+            "forbidden": ["collective-permute", "all-to-all"],
+        }
+        if stage == 1:
+            expected["all-reduce"] = {
+                "min_bytes": padded_bytes,
+                "max_bytes": padded_bytes + slack,
+                "axes": [axis],
+            }
+            expected["forbidden"].append("reduce-scatter")
+        else:
+            expected["reduce-scatter"] = {
+                "min_bytes": padded_bytes // n,
+                "max_bytes": padded_bytes // n + slack,
+                "axes": [axis],
+            }
+            # stage 2's defining property: NO full-grad all-reduce
+            expected["all-reduce"] = {"max_bytes": slack}
+    return {
+        "fn": step,
+        "args": args,
+        "lowered": "train_step",
+        "meta": {
+            "zero_stage": stage,
+            "param_bytes": param_bytes,
+            "padded_param_bytes": padded_bytes,
+            "n_param_leaves": n_leaves,
+        },
+        "expected": expected,
+    }
 
 
 def zero_clip_by_global_norm(

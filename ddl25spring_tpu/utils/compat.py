@@ -71,3 +71,84 @@ def typeof(x):
     if hasattr(jax, "typeof"):
         return jax.typeof(x)
     return jax.core.get_aval(x)
+
+
+# --------------------------------------------------- compiled-program probes
+#
+# The compile-time analytics (obs/xla_analytics.py) lean on two Compiled
+# APIs whose shape drifts across jax versions:
+#
+# - ``compiled.cost_analysis()``: current jax returns one dict; 0.4.x
+#   returns a per-module LIST of dicts (take the entry module's);
+# - ``compiled.memory_analysis()``: a ``CompiledMemoryStats`` whose field
+#   set grew over time (``peak_memory_in_bytes`` is absent on 0.4.x,
+#   where the peak must be assembled from argument/output/temp sizes),
+#   and which some backends don't implement at all.
+#
+# These two helpers are the single call-sites for both APIs — everything
+# else (utils/flops.compiled_flops included) goes through them.
+
+# CompiledMemoryStats fields worth surfacing, oldest-API first
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+    "peak_memory_in_bytes",
+)
+
+
+def compiled_cost_analysis(compiled) -> dict | None:
+    """``compiled.cost_analysis()`` normalized to ONE flat dict (or None
+    where the backend exposes no cost model)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — no cost model on this backend
+        return None
+    if isinstance(ca, (list, tuple)):  # jax <= 0.4.x: per-module list
+        ca = ca[0] if ca else None
+    if not ca:
+        return None
+    return dict(ca)
+
+
+def compiled_memory_stats(compiled) -> dict | None:
+    """``compiled.memory_analysis()`` normalized to a plain dict, with a
+    ``peak_hbm_bytes`` estimate that works on every API vintage: the
+    backend's own ``peak_memory_in_bytes`` when present, else
+    ``arguments + outputs + temps + generated code - aliased`` (the
+    compiled buffers that must coexist)."""
+    ma = getattr(compiled, "memory_analysis", None)
+    if ma is None:
+        return None
+    try:
+        ma = ma()
+    except Exception:  # noqa: BLE001 — backend without memory stats
+        return None
+    if ma is None:
+        return None
+    out: dict = {}
+    if isinstance(ma, dict):  # hypothetical dict-shaped future API
+        out = {
+            k: int(v) for k, v in ma.items()
+            if isinstance(v, (int, float)) and k in _MEMORY_FIELDS
+        }
+    else:
+        for k in _MEMORY_FIELDS:
+            v = getattr(ma, k, None)
+            if v is not None:
+                out[k] = int(v)
+    if not out:
+        return None
+    peak = out.get("peak_memory_in_bytes")
+    if not peak:
+        peak = (
+            out.get("argument_size_in_bytes", 0)
+            + out.get("output_size_in_bytes", 0)
+            + out.get("temp_size_in_bytes", 0)
+            + out.get("generated_code_size_in_bytes", 0)
+            - out.get("alias_size_in_bytes", 0)
+        )
+    out["peak_hbm_bytes"] = int(peak)
+    return out

@@ -33,6 +33,33 @@ PEAK_BF16_FLOPS: dict[str, float] = {
     "TPU7x": 2307e12,        # Ironwood (dense fp8 is higher; bf16 peak)
 }
 
+# Fuller per-chip roofline specs for the compile-time projections
+# (obs/xla_analytics.py): bf16 peak, HBM bandwidth, and aggregate
+# per-chip ICI bandwidth.  Public datasheet numbers, approximate — the
+# projection is a planning instrument, not a measurement.
+CHIP_SPECS: dict[str, dict[str, float]] = {
+    "TPU v4": {
+        "peak_bf16_flops": 275e12,
+        "hbm_bytes_per_s": 1.228e12,
+        "ici_bytes_per_s": 0.30e12,    # 6 links x ~50 GB/s
+    },
+    "TPU v5e": {
+        "peak_bf16_flops": 197e12,
+        "hbm_bytes_per_s": 0.819e12,
+        "ici_bytes_per_s": 0.20e12,    # 4 links x ~50 GB/s
+    },
+    "TPU v5p": {
+        "peak_bf16_flops": 459e12,
+        "hbm_bytes_per_s": 2.765e12,
+        "ici_bytes_per_s": 0.60e12,
+    },
+    "TPU v6e": {
+        "peak_bf16_flops": 918e12,
+        "hbm_bytes_per_s": 1.64e12,
+        "ici_bytes_per_s": 0.448e12,
+    },
+}
+
 
 def chip_peak_flops(device: jax.Device | None = None) -> float | None:
     """Per-chip bf16 peak FLOP/s for ``device`` (default: ``jax.devices()[0]``),
@@ -56,33 +83,37 @@ def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> float | None:
     """Total FLOPs of one invocation per XLA's cost analysis of the compiled
     program (fwd + bwd + optimizer — everything inside the jit boundary).
 
-    Hits the jit cache when the function was already called with these
-    shapes.  Returns None where the backend exposes no cost model — with a
-    one-line warning naming why, so an MFU-less bench line is explained in
-    the log instead of silently blank.
+    Thin wrapper over :func:`ddl25spring_tpu.utils.compat.
+    compiled_cost_analysis` — the one shared ``cost_analysis()``
+    call-site, so version-compat handling lives in exactly one place
+    (obs/xla_analytics.py rides the same helper).  Hits the jit cache
+    when the function was already called with these shapes.  Returns
+    None where the backend exposes no cost model — with a one-line
+    warning naming why, so an MFU-less bench line is explained in the
+    log instead of silently blank.
     """
+    from ddl25spring_tpu.utils.compat import compiled_cost_analysis
+
     try:
         compiled = jitted_fn.lower(*args, **kwargs).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0))
-        if flops <= 0:
-            _log.warning(
-                "XLA cost analysis returned no flops count for %s; "
-                "MFU will be reported as None",
-                getattr(jitted_fn, "__name__", jitted_fn),
-            )
-            return None
-        return flops
     except Exception as e:  # noqa: BLE001 — degrade to None, but say why
         _log.warning(
-            "XLA cost analysis unavailable (%s: %s); MFU will be "
-            "reported as None",
+            "lower/compile for cost analysis failed (%s: %s); MFU will "
+            "be reported as None",
             type(e).__name__,
             e,
         )
         return None
+    ca = compiled_cost_analysis(compiled)
+    flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    if flops <= 0:
+        _log.warning(
+            "XLA cost analysis returned no flops count for %s; "
+            "MFU will be reported as None",
+            getattr(jitted_fn, "__name__", jitted_fn),
+        )
+        return None
+    return flops
 
 
 def mfu(

@@ -1,0 +1,175 @@
+"""Render a strategy's compile-time collective inventory as a table.
+
+    python tools/comms_report.py --strategy dp
+    python tools/comms_report.py --strategy zero3 --mesh 2x4
+    python tools/comms_report.py --strategy dp,zero3 --check   # CI gate
+    python tools/comms_report.py --all --json
+
+No accelerator is involved anywhere: the strategy's train step is
+lowered on a fake CPU mesh (``--xla_force_host_platform_device_count``)
+and the inventory is read off the optimized HLO — see
+``ddl25spring_tpu/obs/xla_analytics.py``.  With ``--check`` the exit
+code is non-zero when any strategy's measured collectives violate its
+declared analytic signature (the comms-regression pin CI runs), or when
+a requested strategy fails to compile at all.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+# CPU-only with a multi-device fake host — must be decided before the
+# first jax backend init (this image registers a TPU plugin at
+# interpreter start, hence the config route in main()).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} GiB"
+
+
+def format_strategy_report(r: dict) -> str:
+    """The human table for one strategy's compile report."""
+    name = r.get("strategy", "?")
+    mesh = r.get("mesh", {})
+    mesh_s = ", ".join(f"{k}={v}" for k, v in mesh.items())
+    lines = [f"strategy: {name}   mesh({mesh_s})   "
+             f"lowered: {r.get('lowered', '?')}"]
+    if "error" in r:
+        lines.append(f"  FAILED to compile on this jax: {r['error']}")
+        return "\n".join(lines)
+
+    cols = (f"  {'collective':<20}{'sites':>6}{'execs':>7}"
+            f"{'payload':>12}{'wire est':>12}  axes")
+    lines.append(cols)
+    lines.append("  " + "-" * (len(cols) - 2))
+    ops = r["collectives"]["ops"]
+    totals = r["collectives"]["totals"]
+    for kind in sorted(totals):
+        t = totals[kind]
+        axes = sorted({
+            ax for o in ops if o["kind"] == kind for ax in (o["axes"] or [])
+        })
+        unknown = any(not o["trip_known"] for o in ops if o["kind"] == kind)
+        lines.append(
+            f"  {kind:<20}{t['sites']:>6}{t['count']:>7}"
+            f"{_fmt_bytes(t['result_bytes']):>12}"
+            f"{_fmt_bytes(t['wire_bytes']):>12}  "
+            + (",".join(axes) or "?")
+            + ("  (loop trip unknown)" if unknown else "")
+        )
+    if not totals:
+        lines.append("  (no collectives — single-shard program)")
+
+    mem = r.get("memory")
+    if mem:
+        lines.append(
+            f"  peak HBM est/chip: {_fmt_bytes(mem['peak_hbm_bytes'])} "
+            f"(args {_fmt_bytes(mem.get('argument_size_in_bytes', 0))}, "
+            f"temps {_fmt_bytes(mem.get('temp_size_in_bytes', 0))}, "
+            f"out {_fmt_bytes(mem.get('output_size_in_bytes', 0))})"
+        )
+    if r.get("flops"):
+        lines.append(f"  flops/step (cost analysis): {r['flops']:.3e}")
+    proj = r.get("projection") or {}
+    for chip, p in proj.items():
+        lines.append(
+            f"  projected on {chip}: step {p['projected_step_s'] * 1e6:.1f} us "
+            f"({p['bound']}-bound), MFU {p['projected_mfu']:.3f}"
+        )
+    viols = r.get("signature_violations")
+    if viols:
+        lines.append("  SIGNATURE VIOLATIONS:")
+        lines.extend(f"    - {v}" for v in viols)
+    elif r.get("expected"):
+        lines.append("  signature: OK (matches the declared analytic "
+                     "collective signature)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    import jax
+
+    # env alone is too late on images whose sitecustomize registers a TPU
+    # plugin at interpreter start; the config call forces CPU regardless
+    jax.config.update("jax_platforms", "cpu")
+
+    from ddl25spring_tpu.obs.compile_report import (
+        DEFAULT_STRATEGIES,
+        build_compile_report,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--strategy", default=None,
+                    help="strategy name(s), comma-separated "
+                         f"(known: {', '.join(DEFAULT_STRATEGIES)})")
+    ap.add_argument("--all", action="store_true",
+                    help="report every registered strategy")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh sizes like 2x4 (positional onto the "
+                         "strategy's axis names; extras fold into the "
+                         "last axis)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw report JSON instead of the table")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any signature violation or "
+                         "compile failure (the CI comms-regression gate)")
+    args = ap.parse_args(argv)
+
+    if args.all or not args.strategy:
+        names = list(DEFAULT_STRATEGIES) if args.all else ["dp"]
+    else:
+        names = [s.strip() for s in args.strategy.split(",") if s.strip()]
+    mesh_sizes = (
+        tuple(int(x) for x in args.mesh.lower().split("x"))
+        if args.mesh else None
+    )
+
+    report = build_compile_report(names, mesh_sizes)
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        blocks = [
+            format_strategy_report(r)
+            for r in report["strategies"].values()
+        ]
+        print(f"compile-time collective inventory (jax "
+              f"{report['jax_version']}, backend {report['backend']}; no "
+              "accelerator required)\n")
+        print("\n\n".join(blocks))
+
+    if args.check:
+        bad = 0
+        for name, r in report["strategies"].items():
+            if "error" in r:
+                print(f"CHECK FAIL {name}: did not compile: {r['error']}",
+                      file=sys.stderr)
+                bad += 1
+            for v in r.get("signature_violations", []):
+                print(f"CHECK FAIL {name}: {v}", file=sys.stderr)
+                bad += 1
+        if bad:
+            return 1
+        print(f"\ncomms check OK: {len(report['strategies'])} strategy "
+              "signature(s) hold", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
