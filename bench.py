@@ -287,6 +287,12 @@ def main(argv=None) -> None:
                     help="skip the compile report on the accelerator path")
     args = ap.parse_args(argv)
 
+    # 0/negative would skip the retry loop entirely and print a
+    # contract-violating `last={}` line with only an `error` key
+    if args.attempts < 1:
+        print(f"clamping --attempts {args.attempts} -> 1", file=sys.stderr)
+        args.attempts = 1
+
     if args.smoke:
         args.cpu = True
         args.no_fedavg = True

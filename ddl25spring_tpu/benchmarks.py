@@ -14,6 +14,7 @@ pipeline.  Parity anchor: the benchmark config of ``lab/run-b2.sh``
 from __future__ import annotations
 
 import time
+from functools import partial
 from typing import Any
 
 import jax
@@ -24,6 +25,7 @@ import optax
 from ddl25spring_tpu.data.native_loader import normalize_on_device
 from ddl25spring_tpu.models.resnet import ResNet18, make_resnet_stages
 from ddl25spring_tpu.ops.losses import cross_entropy_logits
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.parallel.dp import make_dp_train_step
 from ddl25spring_tpu.parallel.het_pipeline import make_het_pipeline_train_step
 from ddl25spring_tpu.utils.mesh import make_mesh
@@ -40,6 +42,7 @@ def build_resnet_step(
     lr: float = 0.1,
     dtype: Any = None,
     instrument: bool | None = None,
+    donate: bool | None = None,
 ):
     """Build the north-star train step on ``devices[: dp * S]``.
 
@@ -54,6 +57,12 @@ def build_resnet_step(
     (:mod:`ddl25spring_tpu.obs` counters; None = follow the global flag,
     True/False hard-enable/-disable,
     zero-cost and HLO-identical when disabled).
+
+    ``donate`` (default on): the returned step aliases its params/
+    opt-state inputs to the outputs (``donate_argnums=(0, 1)``), so the
+    ResNet replica + momentum buffers live once in HBM instead of twice
+    across the update — callers must rebind ``params, opt_state`` from
+    the step's outputs every call (``timed_run`` and both drivers do).
     """
     if S not in (1, 2, 3, 4):
         raise ValueError(f"resnet pipeline supports S in (1, 2, 3, 4), got {S}")
@@ -92,7 +101,7 @@ def build_resnet_step(
             compute_dtype=dtype, instrument=instrument,
         )
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate_argnums(donate))
         def step(params, opt_state, raw):
             x = normalize_on_device(raw[0], dtype)
             return inner(params, opt_state, {"x": x, "y": raw[1]})
@@ -114,7 +123,7 @@ def build_resnet_step(
         )
         key = jax.random.PRNGKey(1)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate_argnums(donate))
         def step(params, opt_state, raw):
             x = normalize_on_device(raw[0], dtype)
             return inner(params, opt_state, (x, raw[1]), key)
@@ -147,6 +156,7 @@ def build_resnet_scan_step(
     lr: float = 0.1,
     dtype: Any = None,
     instrument: bool | None = None,
+    donate: bool | None = None,
 ):
     """K train steps per dispatch: the on-device input+train loop.
 
@@ -175,13 +185,14 @@ def build_resnet_scan_step(
     should use K=1 / `build_resnet_step`, as `bench.py` and the b2 driver
     do automatically.
     """
+
     step1, params, opt_state, meta = build_resnet_step(
         devices, dp, S, num_microbatches, batch, lr, dtype,
-        instrument=instrument,
+        instrument=instrument, donate=donate,
     )
     K = scan_steps
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def multi(params, opt_state, xs, ys, key, epoch, off0):
         perm = jax.random.permutation(jax.random.fold_in(key, epoch), n_data)
 

@@ -30,6 +30,25 @@ dependencies:
   functional model file; swap in a real SentencePiece-trained artifact
   any time and everything downstream is unchanged.
 
+Known divergences from real SentencePiece (the wrapper in
+:mod:`ddl25spring_tpu.data.tokenizer` warns once when it swaps this in):
+
+- **Normalizer**: real SentencePiece applies the model's precompiled
+  normalizer before segmentation — by default ``nmt_nfkc`` (NFKC
+  Unicode normalization plus space folding).  This module only performs
+  the space -> ``▁`` replacement with a dummy prefix and skips NFKC
+  entirely (the precompiled charsmap in the proto is not decoded), so
+  text containing compatibility characters (full-width forms, ligatures
+  like ``ﬁ``, superscripts) segments differently than under the real
+  library.
+- **Byte fallback**: models trained with ``--byte_fallback`` carry 256
+  ``<0xNN>`` BYTE-type pieces so any character not covered by the vocab
+  still encodes losslessly.  Here uncovered characters map to ``<unk>``
+  with a large Viterbi penalty instead — decode cannot round-trip them,
+  exactly the lossy behavior byte fallback exists to avoid (the in-tree
+  :class:`~ddl25spring_tpu.data.tokenizer.BpeTokenizer` is the
+  dependency-free choice when round-trip exactness matters).
+
 TPU note: tokenization is host-side and off the hot path (the reference's
 is too); this module exists for capability parity + artifact
 compatibility, not speed.

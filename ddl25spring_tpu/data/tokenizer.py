@@ -103,10 +103,26 @@ class SentencePieceTokenizer:
 
             self._sp = spm.SentencePieceProcessor(model_file=model_path)
         except ImportError:
+            import warnings
+
             from ddl25spring_tpu.data.sp_model import (
                 PySentencePieceProcessor,
             )
 
+            # one-time (warnings dedup per call site): the pure-Python
+            # processor is an APPROXIMATION of real SentencePiece — see
+            # the divergence notes in ddl25spring_tpu/data/sp_model.py's
+            # module docstring (no NFKC normalization, no byte-fallback
+            # pieces) — so a silent swap could mask tokenization drift
+            warnings.warn(
+                "sentencepiece is not importable; falling back to the "
+                "in-tree PySentencePieceProcessor for "
+                f"{model_path!r}. Encodings approximate real "
+                "SentencePiece (unigram Viterbi without NFKC "
+                "normalization or byte-fallback; see "
+                "ddl25spring_tpu/data/sp_model.py).",
+                stacklevel=2,
+            )
             self._sp = PySentencePieceProcessor(model_path)
         self.vocab_size = self._sp.vocab_size()
         # keep SentencePiece's -1 sentinel when the model has no pad piece:

@@ -37,7 +37,7 @@ COMPILE_REPORT_BASENAME = "compile_report.json"
 
 # strategies cheap enough to compile on every CI run, in report order
 DEFAULT_STRATEGIES = (
-    "dp", "zero1", "zero2", "zero3",
+    "dp", "zero1", "zero2", "zero3", "zero3-prefetch",
     "pipeline", "het_pipeline", "tp", "sp", "ep",
 )
 

@@ -374,6 +374,11 @@ def analyze_compiled(
             "by_axis": totals_by_axis(ops),
         },
         "memory": memory,
+        # buffer-donation accounting: the bytes the compiled program
+        # aliases in place instead of double-buffering (0 = undonated)
+        "donation": {
+            "hbm_saved_bytes": (memory or {}).get("alias_size_in_bytes", 0),
+        },
         "flops": flops if flops and flops > 0 else None,
         "bytes_accessed": bytes_accessed,
         "projection": roofline_projection(
@@ -443,9 +448,41 @@ def check_signature(
              "min_bytes": B, "max_bytes": B2,   # total payload bytes
              "axes": ["data"],         # every op of the kind groups only here
           },
+          "memory": {                  # peak-HBM budget (memory_analysis)
+             "max_peak_hbm_bytes": B,
+          },
+          "donation": {                # buffer-donation savings: the bytes
+             "min_saved_bytes": B,     #   aliased in place of fresh output
+          },                           #   buffers (alias_size_in_bytes)
         }
     """
     viols: list[str] = []
+    mem = report.get("memory") or {}
+    want_mem = expected.get("memory")
+    if want_mem and "max_peak_hbm_bytes" in want_mem:
+        peak = mem.get("peak_hbm_bytes")
+        if peak is None:
+            viols.append("memory: no peak-HBM estimate on this backend, "
+                         "cannot check the budget")
+        elif peak > want_mem["max_peak_hbm_bytes"]:
+            viols.append(
+                f"memory: peak HBM {peak} B exceeds the "
+                f"{want_mem['max_peak_hbm_bytes']} B budget"
+            )
+    want_don = expected.get("donation")
+    if want_don and "min_saved_bytes" in want_don:
+        if "alias_size_in_bytes" not in mem:
+            # no memory stats != zero bytes donated: report the missing
+            # instrument, not a phantom donation regression
+            viols.append("donation: no aliasing stats on this backend, "
+                         "cannot check the donation floor")
+        elif mem["alias_size_in_bytes"] < want_don["min_saved_bytes"]:
+            viols.append(
+                f"donation: only {mem['alias_size_in_bytes']} B aliased "
+                f"in place, expected >= {want_don['min_saved_bytes']} B — "
+                "a train step stopped donating its params/opt-state "
+                "buffers"
+            )
     ops = report["collectives"]["ops"]
     totals = report["collectives"]["totals"]
     scalar = int(expected.get("scalar_bytes", 0))
@@ -460,7 +497,9 @@ def check_signature(
                 f"e.g. {bad[0]['result_bytes']} B at {bad[0]['source']}"
             )
     for kind, want in expected.items():
-        if kind in ("forbidden", "scalar_bytes") or not isinstance(want, dict):
+        if kind in ("forbidden", "scalar_bytes", "memory", "donation") or (
+            not isinstance(want, dict)
+        ):
             continue
         kops = [o for o in ops if o["kind"] == kind]
         count = sum(o["count"] for o in kops)
@@ -517,6 +556,14 @@ STRATEGIES: dict[str, dict[str, Any]] = {
     "zero3": {
         "module": "ddl25spring_tpu.parallel.zero",
         "axes": ("data",), "default_mesh": (4,), "kwargs": {"stage": 3},
+    },
+    "zero3-prefetch": {
+        # the scanned-LLaMA double-buffered gather-prefetch step: the
+        # layer i+1 all-gather issues before layer i's compute, inside a
+        # while loop whose trip count the analytics read off the HLO
+        "module": "ddl25spring_tpu.parallel.zero",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"stage": 3, "prefetch": True},
     },
     "pipeline": {
         "module": "ddl25spring_tpu.parallel.pipeline",

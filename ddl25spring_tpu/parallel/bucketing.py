@@ -1,0 +1,229 @@
+"""Flat-buffer bucketing for collective launches.
+
+Every collective launch pays a fixed cost — an HLO op, a DMA setup, a
+barrier on the slowest participant — so issuing one all-reduce /
+all-gather / reduce-scatter **per pytree leaf** (how DP and ZeRO shipped
+through PR 2) multiplies that cost by the leaf count.  The classic fix
+(DDP gradient bucketing; "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training", arXiv:2004.13336) is to pack leaves
+into a few contiguous, dtype-homogeneous buffers and run the collective
+per *bucket*: O(n_buckets) launches instead of O(n_leaves), with
+n_buckets set by a byte threshold.
+
+This module is the shared planning/packing layer:
+
+- :func:`plan_buckets` groups a pytree's leaves into dtype-homogeneous
+  buckets under a byte threshold (defaults to
+  :data:`DEFAULT_BUCKET_BYTES` = 4 MiB), preserving leaf order within a
+  dtype.  Planning is pure metadata (shapes/dtypes only) so it works on
+  tracers at trace time — callers without a params template (e.g.
+  ``make_dp_train_step``) plan inside the traced function.
+- :meth:`BucketPlan.pack` / :meth:`BucketPlan.unpack` move a concrete
+  pytree into / out of the flat buffers (concatenate of ``reshape(-1)``;
+  XLA lowers both to free bitcasts + copies that fuse with the
+  collective).
+- :func:`bucketed_pmean` is the drop-in for a per-leaf
+  ``jax.tree.map(lambda g: lax.pmean(g, axis), grads)``: pack, pmean
+  each bucket, unpack.  ``pmean``/``psum`` are elementwise across
+  devices, so ``pmean(concat(xs)) == concat(pmean(xs))`` **bitwise** —
+  pinned in ``tests/test_bucketing.py``.
+
+ZeRO's row-packed ``[n, k]`` layout buckets with the same plan by
+overriding the per-leaf packed size (``sizes=`` = the padded row length
+``k``); the gather/scatter plumbing specific to that layout lives in
+:mod:`ddl25spring_tpu.parallel.zero`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+
+def donation_default() -> bool:
+    """Resolve the ``donate=None`` default of every train-step builder.
+
+    Buffer donation is ON by default (``donate_argnums=(0, 1)`` aliases
+    the params/opt-state inputs to the matching outputs, halving their
+    HBM residency) and opt-out via ``DDL25_DONATE=0`` — the test suite's
+    ``conftest.py`` sets that, because the equivalence-oracle tests
+    re-use one input tree across several steps, which donation
+    (correctly) invalidates.  Donation-specific tests and every
+    ``describe()`` compile-analytics hook pass ``donate=True``
+    explicitly, so the pinned programs are the donated ones.
+    """
+    return os.environ.get("DDL25_DONATE", "1") not in ("", "0")
+
+
+def donate_argnums(donate: bool | None) -> tuple[int, ...]:
+    """The ``jax.jit(donate_argnums=...)`` value every train-step builder
+    uses: alias the params (arg 0) and optimizer state (arg 1) inputs to
+    the matching outputs, so the updated trees reuse the old trees'
+    buffers instead of double-residing in HBM for the step's duration.
+    RNG keys are not donated — no output aliases them, so donating the
+    8-byte buffer would only buy an unusable-donation warning.
+
+    ``donate=None`` resolves via :func:`donation_default`."""
+    if donate is None:
+        donate = donation_default()
+    return (0, 1) if donate else ()
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    """Grouping of a pytree's leaves into dtype-homogeneous flat buckets.
+
+    ``buckets[b]`` lists leaf indices (flatten order); ``sizes[i]`` is
+    the element count leaf ``i`` contributes to its bucket (== the leaf
+    size for plain packing; == the padded row length ``k`` for ZeRO's
+    ``[n, k]`` layout).  Frozen + hashable-free: built fresh at trace
+    time, never cached across traces.
+    """
+
+    treedef: jax.tree_util.PyTreeDef
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple
+    sizes: tuple[int, ...]
+    buckets: tuple[tuple[int, ...], ...]
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.sizes)
+
+    def bucket_dtype(self, b: int):
+        return self.dtypes[self.buckets[b][0]]
+
+    def bucket_size(self, b: int) -> int:
+        """Total elements in bucket ``b``."""
+        return sum(self.sizes[i] for i in self.buckets[b])
+
+    def offsets(self, b: int) -> list[int]:
+        """Element offset of each slot within bucket ``b``'s buffer."""
+        offs, acc = [], 0
+        for i in self.buckets[b]:
+            offs.append(acc)
+            acc += self.sizes[i]
+        return offs
+
+    def pack(self, tree) -> list[jax.Array]:
+        """Pytree -> one 1-D buffer per bucket (leaves flattened in
+        bucket order).  Leaf ``i`` must hold exactly ``sizes[i]``
+        elements."""
+        leaves = self.treedef.flatten_up_to(tree)
+        bufs = []
+        for idxs in self.buckets:
+            parts = [leaves[i].reshape(-1) for i in idxs]
+            bufs.append(parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts))
+        return bufs
+
+    def unpack(self, bufs) -> object:
+        """Inverse of :meth:`pack`: buffers -> pytree with the plan's
+        leaf shapes/dtypes."""
+        leaves: list = [None] * self.n_leaves
+        for b, idxs in enumerate(self.buckets):
+            off = 0
+            for i in idxs:
+                leaves[i] = (
+                    bufs[b][off:off + self.sizes[i]]
+                    .reshape(self.shapes[i])
+                    .astype(self.dtypes[i])
+                )
+                off += self.sizes[i]
+        return self.treedef.unflatten(leaves)
+
+
+def plan_buckets(
+    tree,
+    bucket_bytes: int | float = DEFAULT_BUCKET_BYTES,
+    sizes: list[int] | None = None,
+) -> BucketPlan:
+    """Greedy order-preserving packing: walk the leaves in flatten order,
+    appending each to the open bucket of its dtype until adding it would
+    exceed ``bucket_bytes``, then seal and open a new one.  Every leaf
+    lands somewhere (a single leaf above the threshold gets a bucket of
+    its own), and buckets never mix dtypes — a bf16 grad concatenated
+    into an fp32 buffer would silently upcast the wire bytes.
+
+    ``sizes`` overrides the per-leaf packed element count (ZeRO's padded
+    ``k`` rows); default is the leaf's own size.  Only shapes/dtypes are
+    read, so ``tree`` may hold tracers.
+    """
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    # getattr-first so abstract templates (jax.ShapeDtypeStruct from
+    # eval_shape) plan identically to concrete arrays
+    shapes = tuple(
+        tuple(l.shape) if hasattr(l, "shape") else tuple(jnp.shape(l))
+        for l in leaves
+    )
+    dtypes = tuple(jnp.result_type(l) for l in leaves)
+    if sizes is None:
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    if len(sizes) != len(leaves):
+        raise ValueError(
+            f"sizes has {len(sizes)} entries for {len(leaves)} leaves"
+        )
+    bucket_bytes = max(int(bucket_bytes), 1)
+    open_by_dtype: dict = {}  # dtype -> (indices, bytes)
+    buckets: list[tuple[int, ...]] = []
+    order: list = []  # dtype keys in first-seen order, for determinism
+    for i, (dt, sz) in enumerate(zip(dtypes, sizes)):
+        nbytes = sz * dt.itemsize
+        cur = open_by_dtype.get(dt)
+        if cur is None:
+            open_by_dtype[dt] = ([i], nbytes)
+            order.append(dt)
+            continue
+        idxs, used = cur
+        if used + nbytes > bucket_bytes and idxs:
+            buckets.append(tuple(idxs))
+            open_by_dtype[dt] = ([i], nbytes)
+        else:
+            idxs.append(i)
+            open_by_dtype[dt] = (idxs, used + nbytes)
+    for dt in order:
+        idxs, _ = open_by_dtype[dt]
+        if idxs:
+            buckets.append(tuple(idxs))
+    return BucketPlan(
+        treedef=treedef,
+        shapes=shapes,
+        dtypes=dtypes,
+        sizes=tuple(int(s) for s in sizes),
+        buckets=tuple(buckets),
+    )
+
+
+def n_buckets_for(tree, bucket_bytes: int | float = DEFAULT_BUCKET_BYTES,
+                  sizes: list[int] | None = None) -> int:
+    """Bucket count the plan would produce (for describe() metadata and
+    the compile-report ``n_buckets`` column)."""
+    return plan_buckets(tree, bucket_bytes, sizes).n_buckets
+
+
+def bucketed_pmean(tree, axis: str,
+                   bucket_bytes: int | float = DEFAULT_BUCKET_BYTES):
+    """``lax.pmean`` over ``axis`` of every leaf, launched per bucket
+    instead of per leaf.  Bitwise-equal to the per-leaf tree-map (psum is
+    elementwise across devices; concatenation commutes with it)."""
+    plan = plan_buckets(tree, bucket_bytes)
+    return plan.unpack([lax.pmean(b, axis) for b in plan.pack(tree)])
+
+
+def bucketed_psum(tree, axis: str,
+                  bucket_bytes: int | float = DEFAULT_BUCKET_BYTES):
+    """Per-bucket ``lax.psum`` of every leaf (see :func:`bucketed_pmean`)."""
+    plan = plan_buckets(tree, bucket_bytes)
+    return plan.unpack([lax.psum(b, axis) for b in plan.pack(tree)])

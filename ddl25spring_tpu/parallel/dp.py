@@ -34,18 +34,24 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from ddl25spring_tpu.parallel import bucketing
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import HAS_VMA, pcast, shard_map
 
 # loss_fn(params, batch, key) -> scalar
 LossFn = Callable[[Any, Any, jax.Array], jax.Array]
 
 
-def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation):
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    donate: bool | None = None,
+):
     """Single-device jitted trainstep (parity: the centralized loop of
     ``lab/tutorial_1b/primer/intro.py:23-33``).  Serves as the serial side of
     the DP-equivalence oracle (SURVEY §4)."""
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch, key):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -62,6 +68,8 @@ def make_dp_train_step(
     axis: str = "data",
     per_shard_rng: bool = True,
     instrument: bool | None = None,
+    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    donate: bool | None = None,
 ):
     """Gradient-aggregation DP trainstep over ``mesh[axis]``.
 
@@ -77,6 +85,21 @@ def make_dp_train_step(
     the step lowers to HLO identical to an uninstrumented build (pinned in
     ``tests/test_obs.py``); enabled, the callbacks cost one host transfer
     per step.
+
+    ``bucket_bytes`` (default 4 MiB): launch the gradient all-reduce per
+    flat dtype-homogeneous **bucket** instead of per pytree leaf —
+    O(n_buckets) collective launches instead of O(n_leaves), same bytes
+    on the wire (:mod:`ddl25spring_tpu.parallel.bucketing`).  Bitwise
+    equal to the per-leaf path (``None``/``0`` restores it): psum is
+    elementwise across devices, so packing commutes with it — pinned in
+    ``tests/test_bucketing.py`` and visible in the compile-time
+    collective inventory (``tests/test_xla_analytics.py``).
+
+    ``donate`` (default on, see :func:`donate_argnums`): alias the
+    params/opt-state inputs to the outputs so the update runs in place —
+    the step's peak HBM drops by ~the params+opt bytes (pinned donated <
+    undonated in ``tests/test_bucketing.py``).  Callers re-using the
+    input trees after the call must pass ``donate=False``.
     """
     from ddl25spring_tpu import obs
 
@@ -91,6 +114,16 @@ def make_dp_train_step(
     def loss_and_pmean_grad(params, batch, key):
         if per_shard_rng:
             key = jax.random.fold_in(key, lax.axis_index(axis))
+
+        if bucket_bytes:
+            # bucketed path: take LOCAL grads (params cast axis-varying so
+            # autodiff inserts no per-leaf psum), then complete the
+            # all_reduce+divide with ONE pmean per flat bucket — the same
+            # arithmetic per element, O(n_buckets) launches
+            lparams = pcast(params, axis, to="varying")
+            loss, grads = jax.value_and_grad(loss_fn)(lparams, batch, key)
+            grads = bucketing.bucketed_pmean(grads, axis, bucket_bytes)
+            return lax.pmean(loss, axis), grads
 
         # The pmean sits INSIDE the differentiated function: its transpose
         # scales each shard's cotangent by 1/n, and shard_map's autodiff
@@ -111,7 +144,7 @@ def make_dp_train_step(
             grads = lax.pmean(grads, axis)
         return loss, grads
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch, key):
         loss, grads = loss_and_pmean_grad(params, batch, key)
         if instr:
@@ -134,6 +167,7 @@ def make_dp_weight_avg_step(
     mesh: Mesh,
     axis: str = "data",
     per_shard_rng: bool = True,
+    donate: bool | None = None,
 ):
     """Weight-aggregation DP: local step, then average weights over ``axis``.
 
@@ -169,7 +203,7 @@ def make_dp_weight_avg_step(
             lax.pmean(loss, axis),
         )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state_stacked, batch, key):
         return local_step_then_avg(params, opt_state_stacked, batch, key)
 
@@ -210,7 +244,7 @@ def _tiny_mlp_workload(n_shards: int):
     return params, loss_fn, batch, param_bytes
 
 
-def describe(mesh: Mesh, axis: str = "data"):
+def describe(mesh: Mesh, axis: str = "data", bucketed: bool = True):
     """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
     lowerable DP train step + example inputs + the analytic collective
     signature.
@@ -220,33 +254,56 @@ def describe(mesh: Mesh, axis: str = "data"):
     all-reduce — total all-reduce payload == grad bytes (+ scalar loss
     reductions), every group over the data axis, and no other collective
     kind at all.  A stray all-gather here means someone broke the
-    replicated-params invariant.
+    replicated-params invariant.  With bucketing (the default) the
+    non-scalar all-reduce additionally collapses to ONE site per grad
+    bucket, and the step is compiled donated — params+opt state aliased
+    in place, pinned via ``memory`` / ``donation`` below.
     """
     n = mesh.shape[axis]
     params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
     tx = optax.sgd(0.1)
     step = make_dp_train_step(
-        loss_fn, tx, mesh, axis=axis, per_shard_rng=False, instrument=False
+        loss_fn, tx, mesh, axis=axis, per_shard_rng=False, instrument=False,
+        bucket_bytes=bucketing.DEFAULT_BUCKET_BYTES if bucketed else None,
+        donate=True,
     )
+    n_buckets = bucketing.n_buckets_for(params) if bucketed else None
+    opt_state = tx.init(params)
+    state_bytes = sum(
+        jnp.size(l) * jnp.result_type(l).itemsize
+        for l in jax.tree.leaves(opt_state)
+    )
+    expected = {
+        "scalar_bytes": 64,
+        "all-reduce": {
+            "min_bytes": param_bytes,
+            "max_bytes": param_bytes + 256,
+            "axes": [axis],
+        },
+        "forbidden": [
+            "all-gather", "reduce-scatter", "collective-permute",
+            "all-to-all",
+        ],
+        # donated params + SGD state alias in place (grad buckets and the
+        # batch still need fresh buffers, hence "at least params+state")
+        "donation": {"min_saved_bytes": param_bytes + state_bytes},
+        # budget pin: the tiny-MLP DP program fits comfortably under 4 MiB
+        # on every jax this repo supports; 10x headroom over measured
+        # (~0.4 MiB) so only a real regression trips it
+        "memory": {"max_peak_hbm_bytes": 4 * 1024 * 1024},
+    }
+    if bucketed:
+        # n_buckets grad all-reduce sites + at most 2 scalar loss pmeans
+        expected["all-reduce"]["max_count"] = n_buckets + 2
     return {
         "fn": step,
-        "args": (params, tx.init(params), batch, jax.random.PRNGKey(0)),
+        "args": (params, opt_state, batch, jax.random.PRNGKey(0)),
         "lowered": "train_step",
         "meta": {
             "param_bytes": param_bytes,
             "grad_bytes": param_bytes,
             "n_param_leaves": len(jax.tree.leaves(params)),
+            **({"n_buckets": n_buckets} if bucketed else {}),
         },
-        "expected": {
-            "scalar_bytes": 64,
-            "all-reduce": {
-                "min_bytes": param_bytes,
-                "max_bytes": param_bytes + 256,
-                "axes": [axis],
-            },
-            "forbidden": [
-                "all-gather", "reduce-scatter", "collective-permute",
-                "all-to-all",
-            ],
-        },
+        "expected": expected,
     }

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -301,37 +302,77 @@ def shard_moe_params(p: Params, mesh: Mesh, axis: str = "expert") -> Params:
     })
 
 
+def make_ep_train_step(
+    tx,
+    mesh: Mesh,
+    axis: str = "expert",
+    capacity_factor: float = 1.25,
+    donate: bool | None = None,
+):
+    """Jitted train step for the standalone EP MoE layer: regression to a
+    target output plus the load-balancing aux loss — the train-step
+    surface the other parallel modules expose, completing the donation
+    contract across ``parallel/*`` (params/opt-state alias in place,
+    :func:`~ddl25spring_tpu.parallel.dp.donate_argnums`).
+
+    ``step(params, opt_state, (x, y))`` with ``params`` from
+    :func:`shard_moe_params` (expert stacks sharded over ``axis``),
+    ``x/y [T, D]`` token-sharded on the leading dim.  The router grad
+    psums over the expert axis automatically (the router is an
+    axis-invariant input under shard_map autodiff), so the compiled step
+    adds one small all-reduce to the layer's all-to-all signature.
+    """
+    import optax
+
+    moe = make_ep_moe_fn(mesh, axis, capacity_factor=capacity_factor)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        out, aux = moe(p, x)
+        return jnp.mean((out - y) ** 2) + aux
+
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
 def describe(mesh: Mesh, axis: str = "expert"):
     """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
-    expert-parallel MoE layer under ``value_and_grad`` + its analytic
-    collective signature.
+    expert-parallel MoE train step + its analytic collective signature.
 
     EP is the only strategy whose defining collective is ``all-to-all``:
     exactly two per forward (dispatch + combine) and two more in the
     backward (an all_to_all transposes to the inverse all_to_all), every
     one over the expert axis.  A reduce-scatter or collective-permute
-    here means the dispatch stopped being a pure bucket exchange.
+    here means the dispatch stopped being a pure bucket exchange.  The
+    full train step adds the replicated router's gradient all-reduce
+    (small, axis-grouped) on top.
     """
+    import optax
+
     cfg_E = mesh.shape[axis]  # experts == axis size: E/ep == 1 per device
     D, F, T = 16, 32, 16 * cfg_E
     params = init_moe_params(jax.random.PRNGKey(0), D, F, cfg_E)
     params = shard_moe_params(params, mesh, axis)
-    moe = make_ep_moe_fn(mesh, axis)
-
-    def scalar_loss(p, x):
-        y, aux = moe(p, x)
-        return jnp.mean(y**2) + aux
-
-    fn = jax.jit(jax.value_and_grad(scalar_loss))
+    tx = optax.sgd(0.1)
+    fn = make_ep_train_step(tx, mesh, axis, donate=True)
     x = jnp.zeros((T, D), jnp.float32)
+    batch = (x, jnp.zeros_like(x))
+    router_bytes = D * cfg_E * 4
     return {
         "fn": fn,
-        "args": (params, x),
-        "lowered": "value_and_grad",
+        "args": (params, tx.init(params), batch),
+        "lowered": "train_step",
         "meta": {
             "n_experts": cfg_E,
             "tokens": T,
             "dmodel": D,
+            "router_bytes": router_bytes,
         },
         "expected": {
             "scalar_bytes": 64,
@@ -340,6 +381,16 @@ def describe(mesh: Mesh, axis: str = "expert"):
                 "max_count": 4,
                 "axes": [axis],
             },
+            # router grad (+ scalar aux reductions) — nothing param-stack
+            # sized may all-reduce here
+            "all-reduce": {
+                "min_bytes": router_bytes,
+                "max_bytes": router_bytes + 256,
+                "axes": [axis],
+            },
             "forbidden": ["collective-permute", "reduce-scatter"],
+            # per-device aliased bytes: router + this device's expert slice
+            "donation": {"min_saved_bytes": router_bytes},
+            "memory": {"max_peak_hbm_bytes": 4 * 1024 * 1024},
         },
     }

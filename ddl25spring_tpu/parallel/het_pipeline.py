@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -198,6 +199,7 @@ def make_het_pipeline_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     num_microbatches: int,
+    donate: bool | None = None,
     **kw,
 ):
     """Jitted DPxPP train step over heterogeneous stages (the benchmark
@@ -207,7 +209,7 @@ def make_het_pipeline_train_step(
         num_microbatches, **kw,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(pipe_loss)(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -284,6 +286,7 @@ def describe(
                 "axes": [stage_axis],
             },
             "forbidden": ["all-to-all", "reduce-scatter", "all-gather"],
+            "memory": {"max_peak_hbm_bytes": 8 * 1024 * 1024},
         },
     }
 
@@ -455,6 +458,7 @@ def make_sharded_het_pipeline_train_step(
     mesh: Mesh,
     num_microbatches: int,
     stage_axis: str = "stage",
+    donate: bool | None = None,
     **kw,
 ):
     """Stage-sharded DPxPP train step: params AND optimizer state live
@@ -471,8 +475,7 @@ def make_sharded_het_pipeline_train_step(
         num_microbatches, stage_axis=stage_axis, **kw,
     )
     opt_state = tx.init(stacked)
-
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(stacked, opt_state, batch):
         loss, grads = jax.value_and_grad(pipe_loss)(stacked, batch)
         updates, opt_state = tx.update(grads, opt_state, stacked)

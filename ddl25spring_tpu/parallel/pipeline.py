@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -1268,6 +1269,7 @@ def make_pipeline_train_step(
     tp_axis: str | None = None,
     seq_axis: str | None = None,
     sp_mode: str = "ring",
+    donate: bool | None = None,
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -1303,6 +1305,9 @@ def make_pipeline_train_step(
     PP, gpipe schedule only — see :func:`make_pipeline_loss`); tokens
     shard their length dim over the axis, ``sp_mode`` picks
     ring/ulysses attention.
+
+    ``donate`` (default on): params/opt-state buffers alias in place
+    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`).
     """
     if seq_axis is not None and schedule not in (
         "gpipe", "1f1b", "interleaved-1f1b"
@@ -1351,7 +1356,7 @@ def make_pipeline_train_step(
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, tokens):
         loss, grads = vag(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -1361,7 +1366,7 @@ def make_pipeline_train_step(
     return step
 
 
-def fuse_train_steps(step_fn, k: int):
+def fuse_train_steps(step_fn, k: int, donate: bool | None = None):
     """Fuse ``k`` train steps into ONE dispatched program.
 
     ``step_fn(params, opt_state, tokens) -> (params, opt_state, loss)``
@@ -1383,7 +1388,7 @@ def fuse_train_steps(step_fn, k: int):
     steps — CPU callers should keep k=1.
     """
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def multi(params, opt_state, tokens_k):
         if tokens_k.shape[0] != k:
             raise ValueError(
@@ -1551,12 +1556,16 @@ def describe(
                 "axes": [stage_axis],
             },
             "forbidden": ["all-to-all", "reduce-scatter"],
+            # loss/value_and_grad lowers (no train-step outputs to alias),
+            # so no donation floor — but the HBM budget still pins
+            "memory": {"max_peak_hbm_bytes": 8 * 1024 * 1024},
         },
     }
 
 
 def make_grad_accum_step(
-    loss_fn: Callable, tx: optax.GradientTransformation, num_microbatches: int
+    loss_fn: Callable, tx: optax.GradientTransformation, num_microbatches: int,
+    donate: bool | None = None,
 ):
     """Single-device microbatch gradient accumulation: chunk the batch, scan
     per-microbatch grads into a summed carry, one optimizer step — the
@@ -1567,8 +1576,7 @@ def make_grad_accum_step(
     their leading dim.
     """
     M = num_microbatches
-
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch, key):
         chunked = jax.tree.map(
             lambda x: x.reshape((M, x.shape[0] // M) + x.shape[1:]), batch

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -378,11 +379,14 @@ def make_sp_train_step(
     seq_axis: str = "seq",
     data_axis: str | None = None,
     mode: str = "ring",
+    donate: bool | None = None,
 ):
-    """Jitted SP(xDP) train step (params replicated, tokens seq-sharded)."""
+    """Jitted SP(xDP) train step (params replicated, tokens seq-sharded).
+    ``donate`` (default on): params/opt-state buffers alias in place
+    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`)."""
     loss_fn = make_sp_loss(cfg, mesh, seq_axis, data_axis, mode)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -418,7 +422,9 @@ def describe(
     dp = mesh.shape[data_axis] if data_axis else 1
     tx = optax.sgd(1e-2)
     params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
-    step = make_sp_train_step(cfg, tx, mesh, seq_axis, data_axis, mode)
+    step = make_sp_train_step(
+        cfg, tx, mesh, seq_axis, data_axis, mode, donate=True
+    )
     tokens = jnp.zeros((4 * dp, cfg.ctx_size), jnp.int32)
     axes = [seq_axis] + ([data_axis] if data_axis else [])
     # fwd: n ring steps x (k, v, pos) rotations per layer + 1 targets hop;
@@ -441,5 +447,7 @@ def describe(
                 "axes": axes,
             },
             **({"forbidden": ["all-to-all"]} if mode == "ring" else {}),
+            "donation": {"min_saved_bytes": 1},
+            "memory": {"max_peak_hbm_bytes": 2 * 1024 * 1024},
         },
     }

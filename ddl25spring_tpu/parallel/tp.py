@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -263,13 +264,16 @@ def make_tp_train_step(
     model_axis: str = "model",
     data_axis: str | None = None,
     shard_vocab: bool = True,
+    donate: bool | None = None,
 ):
     """Jitted TP(xDP) train step; params stay sharded across steps.
     Switch-MoE configs shard their expert stacks over the model axis
-    (:func:`make_tp_moe_fn`) and train with the aux loss folded in."""
+    (:func:`make_tp_moe_fn`) and train with the aux loss folded in.
+    ``donate`` (default on): params/opt-state buffers alias in place
+    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`)."""
     loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis, shard_vocab)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -306,7 +310,9 @@ def describe(
     params = shard_tp_params(
         llama.init_llama_params(jax.random.PRNGKey(0), cfg), mesh, model_axis
     )
-    step = make_tp_train_step(cfg, tx, mesh, model_axis, data_axis)
+    step = make_tp_train_step(
+        cfg, tx, mesh, model_axis, data_axis, donate=True
+    )
     tokens = jnp.zeros((4 * dp, cfg.ctx_size), jnp.int32)
     axes = [model_axis] + ([data_axis] if data_axis else [])
     # per-block psum payload: one [B, L, D] activation in fp32
@@ -331,5 +337,9 @@ def describe(
                 "min_bytes": 2 * cfg.n_layers * act_bytes,
             },
             "forbidden": ["collective-permute"],
+            # the step donates its params/opt-state (floor 1: "donates at
+            # all"; the byte-exact floors live on the dp/zero/ep pins)
+            "donation": {"min_saved_bytes": 1},
+            "memory": {"max_peak_hbm_bytes": 2 * 1024 * 1024},
         },
     }

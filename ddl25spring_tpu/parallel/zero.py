@@ -43,6 +43,8 @@ import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ddl25spring_tpu.parallel import bucketing
+from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.utils.compat import pcast, shard_map
 
 LossFn = Callable[[Any, Any, jax.Array], jax.Array]
@@ -52,6 +54,73 @@ def _leaf_meta(leaf, n: int):
     size = int(np.prod(leaf.shape)) if leaf.shape else 1
     k = -(-size // n)  # ceil
     return size, k
+
+
+def _row_plan(params_template, n: int, bucket_bytes):
+    """Bucket plan over the padded ``[n, k]`` row layout: leaf ``i``
+    contributes its ``k_i`` shard-row elements per device (not its raw
+    size), so one packed bucket row is exactly what one device holds of
+    the bucket's leaves."""
+    ks = [
+        _leaf_meta(leaf, n)[1]
+        for leaf in jax.tree.leaves(params_template)
+    ]
+    return bucketing.plan_buckets(params_template, bucket_bytes, sizes=ks)
+
+
+def _pack_rows(plan, tree):
+    """Pytree of ``[r, k_i]`` leaves -> one ``[r, K_b]`` buffer per bucket
+    (column concat in bucket order; ``r`` is 1 inside shard_map, ``n``
+    outside)."""
+    leaves = plan.treedef.flatten_up_to(tree)
+    return [
+        leaves[idxs[0]] if len(idxs) == 1
+        else jnp.concatenate([leaves[i] for i in idxs], axis=1)
+        for idxs in plan.buckets
+    ]
+
+
+def _split_rows(plan, bufs):
+    """Inverse of :func:`_pack_rows`: ``[r, K_b]`` buffers -> pytree of
+    ``[r, k_i]`` leaves."""
+    leaves: list = [None] * plan.n_leaves
+    for b, idxs in enumerate(plan.buckets):
+        for i, off in zip(idxs, plan.offsets(b)):
+            leaves[i] = bufs[b][:, off:off + plan.sizes[i]]
+    return plan.treedef.unflatten(leaves)
+
+
+def _gather_bucketed(plan, shards, axis: str, n: int):
+    """One tiled all-gather per BUCKET of packed ``[1, k]`` shard rows ->
+    the full param pytree.  The single gather site both ZeRO-3 steps ride
+    (whole-tree in :func:`make_zero_dp_train_step`, per-layer/outer in
+    :func:`make_zero3_llama_train_step`); its transpose is one
+    psum_scatter per bucket — the O(n_leaves) -> O(n_buckets) collapse
+    the analytics pin."""
+    bufs = [
+        lax.all_gather(b.reshape(-1), axis, tiled=True)
+        .reshape(n, plan.bucket_size(i))
+        for i, b in enumerate(_pack_rows(plan, shards))
+    ]
+    return _unpack_full(plan, bufs)
+
+
+def _unpack_full(plan, bufs2d):
+    """Gathered ``[n, K_b]`` bucket buffers -> the ORIGINAL param pytree
+    (shapes/dtypes from the plan's template): per leaf, slice its column
+    band, drop the padding tail, reshape."""
+    leaves: list = [None] * plan.n_leaves
+    for b, idxs in enumerate(plan.buckets):
+        for i, off in zip(idxs, plan.offsets(b)):
+            shape = plan.shapes[i]
+            size = int(np.prod(shape)) if shape else 1
+            leaves[i] = (
+                bufs2d[b][:, off:off + plan.sizes[i]]
+                .reshape(-1)[:size]
+                .reshape(shape)
+                .astype(plan.dtypes[i])
+            )
+    return plan.treedef.unflatten(leaves)
 
 
 def zero_shard_params(params, mesh: Mesh, axis: str = "data"):
@@ -94,6 +163,8 @@ def make_zero_dp_train_step(
     per_shard_rng: bool = True,
     num_microbatches: int = 1,
     instrument: bool | None = None,
+    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    donate: bool | None = None,
 ):
     """Build the fully-sharded trainstep.
 
@@ -126,6 +197,19 @@ def make_zero_dp_train_step(
     activations.  The update is mathematically the full-batch update
     (mean of microbatch means; same reference semantics as
     ``s01_b1_microbatches.py``'s ``.grad`` accumulation).
+
+    ``bucket_bytes`` (default 4 MiB): gather the forward's parameters per
+    flat dtype-homogeneous BUCKET instead of per leaf — and, because the
+    gather sits inside the differentiated function, the backward's
+    reduce-scatters collapse identically: O(n_buckets) collective
+    launches instead of O(n_leaves), same bytes.  ``None``/``0`` restores
+    the per-leaf path; both paths are numerically identical (the packed
+    psum is elementwise — equality pinned in ``tests/test_bucketing.py``,
+    launch counts pinned in ``tests/test_xla_analytics.py``).
+
+    ``donate`` (default on, :func:`~ddl25spring_tpu.parallel.dp.
+    donate_argnums`): alias the param-shard and opt-state inputs to the
+    outputs — the sharded update runs in place.
     """
     from ddl25spring_tpu import obs
 
@@ -150,7 +234,12 @@ def make_zero_dp_train_step(
         obs.counters.add_static("zero.reduce_scatter_bytes_per_step", wire)
         obs.counters.add_static("zero.params_bytes_gathered", gathered)
 
+    plan = _row_plan(params_template, n, bucket_bytes) if bucket_bytes else None
+
     def gather_full(shards):
+        if plan is not None:
+            return _gather_bucketed(plan, shards, axis, n)
+
         def g(s, shape, dtype):
             full = lax.all_gather(s.reshape(-1), axis, tiled=True)
             size = int(np.prod(shape)) if shape else 1
@@ -237,16 +326,31 @@ def make_zero_dp_train_step(
 
         return sharded_step(param_shards, opt_state, batch, key)
 
-    return jax.jit(step)
+    return jax.jit(step, donate_argnums=donate_argnums(donate))
 
 
-def _opt_state_specs(opt_state, shard_shapes: set, axis: str):
+def _opt_state_specs(
+    opt_state, shard_shapes: set, axis: str,
+    stacked_shapes: set | frozenset = frozenset(),
+):
     """PartitionSpecs for an optax state over the ``[n, k]`` shard layout:
     param-shaped 2-D leaves shard over ``axis``, scalars/counters stay
     replicated; any other 2-D leaf is rejected loudly (shared by the
-    ZeRO-3 step and the ZeRO-1/2 steps below)."""
+    ZeRO-3 step and the ZeRO-1/2 steps below).  ``stacked_shapes`` names
+    the layer-stacked ``[L, n, k]`` layouts of the scanned-LLaMA ZeRO-3
+    step — those shard their middle dim (``P(None, axis)``); any other
+    3-D leaf is rejected like a mismatched 2-D one."""
 
     def spec_for(leaf):
+        if jnp.ndim(leaf) == 3 and stacked_shapes:
+            if jnp.shape(leaf) not in stacked_shapes:
+                raise ValueError(
+                    f"optimizer state carries a 3-D leaf of shape "
+                    f"{jnp.shape(leaf)} that matches no [L, n, k] stacked "
+                    f"shard {sorted(stacked_shapes)}; this optax transform "
+                    "is not supported by the ZeRO sharding heuristic"
+                )
+            return P(None, axis)
         if jnp.ndim(leaf) != 2:
             return P()
         if jnp.shape(leaf) not in shard_shapes:
@@ -269,6 +373,8 @@ def make_zero_partitioned_train_step(
     axis: str = "data",
     stage: int = 2,
     per_shard_rng: bool = True,
+    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    donate: bool | None = None,
 ):
     """ZeRO stage-1/2 trainstep: REPLICATED params, SHARDED optimizer
     state (and, at stage 2, sharded reduced gradients).
@@ -299,6 +405,12 @@ def make_zero_partitioned_train_step(
     ``tests/test_zero.py``).  ``step(params, opt_state, batch, key)``
     with ``params`` replicated and ``opt_state`` in the ``[n, k]``
     sharded layout.
+
+    ``bucket_bytes`` (default 4 MiB) routes all three collectives through
+    flat buckets — the stage-1 all-reduce, the stage-2 reduce-scatter,
+    and the updated-rows all-gather each launch once per BUCKET instead
+    of once per leaf; ``donate`` (default on) aliases params/opt-state in
+    place.
     """
     if stage not in (1, 2):
         raise ValueError(f"stage must be 1 or 2, got {stage} "
@@ -310,6 +422,7 @@ def make_zero_partitioned_train_step(
         for l in jax.tree.leaves(params_template)
     ]
     shard_shapes = {(n, k) for _, k in metas}
+    plan = _row_plan(params_template, n, bucket_bytes) if bucket_bytes else None
 
     def pack(leaf, meta):
         size, k = meta
@@ -323,12 +436,16 @@ def make_zero_partitioned_train_step(
 
     def step(params, opt_state, batch, key):
         state_specs = _opt_state_specs(opt_state, shard_shapes, axis)
+        out_params_specs = (
+            tuple(P(axis) for _ in plan.buckets) if plan is not None
+            else P(axis)
+        )
 
         @partial(
             shard_map,
             mesh=mesh,
             in_specs=(P(), state_specs, P(axis), P()),
-            out_specs=(P(axis), state_specs, P()),
+            out_specs=(out_params_specs, state_specs, P()),
         )
         def sharded_step(params, ostate, b, key):
             if per_shard_rng:
@@ -340,44 +457,325 @@ def make_zero_partitioned_train_step(
             loss, grads = jax.value_and_grad(loss_fn)(lparams, b, key)
             g2d = pack_tree(grads)
             i = lax.axis_index(axis)
-            if stage == 1:
-                # sum everywhere (grad memory O(P)), then take our rows
-                g2d = jax.tree.map(lambda g: lax.pmean(g, axis), g2d)
-                gshard = jax.tree.map(
-                    lambda g: lax.dynamic_slice_in_dim(g, i, 1, 0), g2d
-                )
+            if plan is not None:
+                # packed [n, K_b] bucket buffers: one collective per
+                # bucket below instead of one per leaf
+                g2d = _pack_rows(plan, g2d)
+
+            def reduce_to_shard(g):
+                if stage == 1:
+                    # sum everywhere (grad memory O(P)), then take our rows
+                    return lax.dynamic_slice_in_dim(
+                        lax.pmean(g, axis), i, 1, 0
+                    )
+                # stage 2: reduce straight into our rows (grad mem O(P/n))
+                return lax.psum_scatter(
+                    g, axis, scatter_dimension=0, tiled=True
+                ) / n
+
+            if plan is not None:
+                gshard = _split_rows(plan, [reduce_to_shard(g) for g in g2d])
             else:
-                # reduce straight into our rows (grad memory O(P/n))
-                gshard = jax.tree.map(
-                    lambda g: lax.psum_scatter(
-                        g, axis, scatter_dimension=0, tiled=True
-                    ) / n,
-                    g2d,
-                )
+                gshard = jax.tree.map(reduce_to_shard, g2d)
             pshard = jax.tree.map(
                 lambda p: lax.dynamic_slice_in_dim(p, i, 1, 0),
                 pack_tree(params),
             )
             updates, ostate = tx.update(gshard, ostate, pshard)
             new_shard = optax.apply_updates(pshard, updates)
+            if plan is not None:
+                # hand the updated rows back bucket-packed so the
+                # P(axis) -> P() resharding below gathers per bucket
+                new_shard = tuple(_pack_rows(plan, new_shard))
             return new_shard, ostate, lax.pmean(loss, axis)
 
         new_shards, opt_state, loss = sharded_step(
             params, opt_state, batch, key
         )
         # P(axis) -> P(): the partitioner lowers this resharding to ONE
-        # all-gather per leaf — the explicit gather half of the stage-1/2
-        # comms story
+        # all-gather per leaf (per BUCKET when packing) — the explicit
+        # gather half of the stage-1/2 comms story
         gathered = jax.lax.with_sharding_constraint(
             new_shards, NamedSharding(mesh, P())
         )
-        params = zero_unshard_params(gathered, params)
+        if plan is not None:
+            params = _unpack_full(plan, list(gathered))
+        else:
+            params = zero_unshard_params(gathered, params)
         return params, opt_state, loss
 
-    return jax.jit(step)
+    return jax.jit(step, donate_argnums=donate_argnums(donate))
 
 
-def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
+# ------------------------------------------------- scanned-LLaMA prefetch
+
+
+def zero_shard_llama_params(params, mesh: Mesh, axis: str = "data"):
+    """LLaMA param pytree -> the per-LAYER ZeRO-3 shard layout the
+    prefetch step consumes: each stacked ``blocks`` leaf ``[L, ...]``
+    packs layer-wise into ``[L, n, k]`` (``P(None, axis)`` — device ``i``
+    holds row ``i`` of every layer), the outer leaves (embed/ln_f/
+    unembed) into the ordinary ``[n, k]`` of :func:`zero_shard_params`.
+    Layer-wise packing is what lets the scan gather ONE layer's params
+    at a time instead of the whole stack."""
+    n = mesh.shape[axis]
+
+    def pack_block(leaf):
+        leaf = jnp.asarray(leaf)
+        L = leaf.shape[0]
+        size = int(np.prod(leaf.shape[1:])) if leaf.shape[1:] else 1
+        k = -(-size // n)
+        flat = jnp.pad(leaf.reshape(L, -1), ((0, 0), (0, n * k - size)))
+        return jax.device_put(
+            flat.reshape(L, n, k), NamedSharding(mesh, P(None, axis))
+        )
+
+    out = dict(params)
+    out["blocks"] = jax.tree.map(pack_block, params["blocks"])
+    outer = {k: v for k, v in params.items() if k != "blocks"}
+    out.update(zero_shard_params(outer, mesh, axis))
+    return out
+
+
+def zero_unshard_llama_params(shards, template):
+    """Inverse of :func:`zero_shard_llama_params` (host-side; for eval/
+    checkpoint interop with the replicated model)."""
+
+    def unpack_block(s, t):
+        L = s.shape[0]
+        size = int(np.prod(t.shape[1:])) if t.shape[1:] else 1
+        return (
+            s.reshape(L, -1)[:, :size].reshape(t.shape).astype(t.dtype)
+        )
+
+    out = dict(shards)
+    out["blocks"] = jax.tree.map(
+        unpack_block, shards["blocks"], template["blocks"]
+    )
+    outer_t = {k: v for k, v in template.items() if k != "blocks"}
+    out.update(zero_unshard_params(
+        {k: shards[k] for k in outer_t}, outer_t
+    ))
+    return out
+
+
+def make_zero3_llama_train_step(
+    cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "data",
+    bucket_bytes: int | float = bucketing.DEFAULT_BUCKET_BYTES,
+    prefetch: bool = True,
+    per_shard_rng: bool = True,
+    donate: bool | None = None,
+):
+    """ZeRO-3 over the scanned LLaMA layer stack with GATHER PREFETCH:
+    the all-gather for layer ``i+1``'s parameters is issued *before*
+    layer ``i``'s compute consumes its own — a double-buffered scan
+    carry — so XLA's async collective pair (``all-gather-start`` /
+    ``-done``) can overlap the ICI transfer with the MXU work of the
+    current layer (the overlap schedule of arXiv:2204.06514 §4.2
+    expressed in one shard_map program).
+
+    Where :func:`make_zero_dp_train_step` gathers the WHOLE tree up
+    front (every layer's params resident before the first matmul and an
+    exposed gather latency at step start), this step walks the stacked
+    ``blocks`` with ``lax.scan`` and keeps at most TWO layers' full
+    params live in the forward: the one being consumed and the one in
+    flight.  Collectives ride the flat-bucket path per layer
+    (:mod:`ddl25spring_tpu.parallel.bucketing`), so the program shows
+    ONE gather site per layer-bucket inside a while loop whose trip
+    count XLA pins to ``n_layers`` — the shape
+    ``tests/test_xla_analytics.py`` asserts.
+
+    ``prefetch=False`` drops the double buffer and instead gathers
+    inside a ``jax.checkpoint``-wrapped layer body: no issue-ahead, but
+    the backward re-gathers instead of keeping the scan's stacked
+    gathered-params residuals — the memory-lean FSDP schedule.  With
+    ``prefetch=True`` the scan transpose stores each iteration's carry
+    (the gathered layer params, ``O(P)`` across the stack), trading
+    backward-pass HBM for the forward overlap — the right trade on the
+    ICI-bound configs this step targets; hand-rolling the backward to
+    get both is future work (ROADMAP).
+
+    ``step(param_shards, opt_state, tokens, key)`` with ``param_shards``
+    from :func:`zero_shard_llama_params`, ``opt_state = tx.init(param_
+    shards)``, ``tokens [B, ctx]`` sharded on the leading dim.  Loss is
+    ``causal_lm_loss`` (+ ``cfg.moe_aux_weight`` x the router aux for
+    switch-MoE configs).  Numerically == replicated DP + the same optax
+    chain (asserted in ``tests/test_bucketing.py``).
+    """
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+
+    n = mesh.shape[axis]
+    L = cfg.n_layers
+    template = jax.eval_shape(
+        lambda: llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    )
+    block_tmpl = template["blocks"]
+    outer_tmpl = {k: v for k, v in template.items() if k != "blocks"}
+    # per-LAYER plan: slot sizes are one layer's padded k rows
+    layer_tmpl = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), block_tmpl
+    )
+    layer_plan = _row_plan(layer_tmpl, n, bucket_bytes)
+    outer_plan = _row_plan(outer_tmpl, n, bucket_bytes)
+    shard_shapes = {
+        (n, _leaf_meta(l, n)[1]) for l in jax.tree.leaves(outer_tmpl)
+    }
+    stacked_shapes = {
+        (L, n, _leaf_meta(jax.ShapeDtypeStruct(l.shape[1:], l.dtype), n)[1])
+        for l in jax.tree.leaves(block_tmpl)
+    }
+
+    def step(param_shards, opt_state, tokens, key):
+        state_specs = _opt_state_specs(
+            opt_state, shard_shapes, axis, stacked_shapes=stacked_shapes
+        )
+        pspecs = dict(
+            {k: P(axis) for k in outer_tmpl},
+            blocks=jax.tree.map(lambda _: P(None, axis), block_tmpl),
+        )
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(pspecs, state_specs, P(axis), P()),
+            out_specs=(pspecs, state_specs, P()),
+        )
+        def sharded_step(pshards, ostate, toks, key):
+            if per_shard_rng:
+                key = jax.random.fold_in(key, lax.axis_index(axis))
+
+            def shard_loss(pshards):
+                outer = _gather_bucketed(
+                    outer_plan,
+                    {k: pshards[k] for k in outer_tmpl},
+                    axis, n,
+                )
+                # local block rows [L, 1, k] -> packed [L, K_b] buffers
+                layer_bufs = [
+                    jnp.concatenate(
+                        [
+                            layer_plan.treedef.flatten_up_to(
+                                pshards["blocks"]
+                            )[i].reshape(L, -1)
+                            for i in idxs
+                        ],
+                        axis=1,
+                    )
+                    for idxs in layer_plan.buckets
+                ]
+
+                def gather_layer(rows):
+                    # rows: one [K_b] row per bucket -> full layer params
+                    bufs = [
+                        lax.all_gather(r, axis, tiled=True)
+                        .reshape(n, layer_plan.bucket_size(b))
+                        for b, r in enumerate(rows)
+                    ]
+                    return _unpack_full(layer_plan, bufs)
+
+                x = llama.embed(outer, toks, cfg)
+                aux0 = pcast(jnp.float32(0.0), axis, to="varying")
+                if prefetch:
+                    def rows_at(i):
+                        return [
+                            lax.dynamic_index_in_dim(b, i, 0, keepdims=False)
+                            for b in layer_bufs
+                        ]
+
+                    def body(carry, i):
+                        x, aux, cur = carry
+                        # issue layer i+1's gather BEFORE layer i's
+                        # compute: the double buffer XLA can turn into
+                        # an in-flight all-gather-start/-done pair
+                        nxt = gather_layer(rows_at(i + 1))
+                        x, a = llama.block_forward(cur, x, cfg)
+                        return (x, aux + a, nxt), None
+
+                    # the last layer is peeled out of the scan: it has
+                    # nothing left to prefetch, so running it in the loop
+                    # would re-gather layer L-1 only to drop the result
+                    cur = gather_layer(rows_at(0))
+                    aux = aux0
+                    if L > 1:
+                        (x, aux, cur), _ = lax.scan(
+                            body, (x, aux, cur), jnp.arange(L - 1)
+                        )
+                    x, a = llama.block_forward(cur, x, cfg)
+                    aux = aux + a
+                else:
+                    # memory-lean remat: the gather lives INSIDE the
+                    # checkpointed body, so the backward re-gathers each
+                    # layer instead of storing the gathered stack
+                    @jax.checkpoint
+                    def one_layer(rows, x):
+                        return llama.block_forward(
+                            gather_layer(list(rows)), x, cfg
+                        )
+
+                    def body(carry, rows):
+                        x, aux = carry
+                        x, a = one_layer(rows, x)
+                        return (x, aux + a), None
+
+                    (x, aux), _ = lax.scan(
+                        body, (x, aux0), tuple(layer_bufs)
+                    )
+                logits = llama.unembed(outer, x, cfg)
+                loss = causal_lm_loss(logits, toks)
+                if cfg.n_experts > 0:
+                    loss = loss + cfg.moe_aux_weight * aux
+                return loss
+
+            loss, gshards = jax.value_and_grad(shard_loss)(pshards)
+            # gather transposes deliver cross-device SUMS; /n -> DP mean
+            gshards = jax.tree.map(lambda g: g / n, gshards)
+            updates, ostate = tx.update(gshards, ostate, pshards)
+            pshards = optax.apply_updates(pshards, updates)
+            return pshards, ostate, lax.pmean(loss, axis)
+
+        return sharded_step(param_shards, opt_state, tokens, key)
+
+    return jax.jit(step, donate_argnums=donate_argnums(donate))
+
+
+def _llama_workload(n: int, n_layers: int = 4):
+    """Tiny LLaMA LM workload for the compile-time analytics: a param
+    tree with a realistic leaf count (stacked blocks + embed/ln_f/
+    unembed), so the per-leaf vs bucketed collective-count gap is
+    visible — the O(n_leaves) -> O(n_buckets) pin runs on this tree."""
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.ops.losses import causal_lm_loss
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=n_layers,
+        ctx_size=16, dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, tokens, key):
+        del key
+        return causal_lm_loss(llama.llama_forward(p, tokens, cfg), tokens)
+
+    tokens = jnp.zeros((2 * n, cfg.ctx_size), jnp.int32)
+    param_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
+    )
+    return cfg, params, loss_fn, tokens, param_bytes
+
+
+def describe(
+    mesh: Mesh,
+    stage: int = 3,
+    axis: str = "data",
+    bucketed: bool = True,
+    workload: str = "mlp",
+    prefetch: bool = False,
+):
     """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
     lowerable ZeRO train step (stage 1, 2, or 3) + example inputs + the
     analytic collective signature.
@@ -389,14 +787,96 @@ def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
       all-gather of the updated param rows;
     - stage 2: reduce-scatter (result = the 1/n grad shard) + the same
       all-gather — no full-grad all-reduce anywhere;
-    - stage 3: per-leaf all-gathers of the padded params in the forward
-      and reduce-scatters out of the backward — no param-sized
-      all-reduce, no update-side gather.
+    - stage 3: all-gathers of the padded params in the forward and
+      reduce-scatters out of the backward — no param-sized all-reduce,
+      no update-side gather.
+
+    ``bucketed`` (the builders' default): the per-leaf launches above
+    collapse to per-BUCKET launches — the expected counts pin
+    O(n_buckets), strictly below ``n_param_leaves`` whenever the tree
+    has more leaves than dtype-buckets.  ``bucketed=False`` describes
+    the legacy per-leaf path (the comparison baseline the bucketing
+    tests compile).  ``workload="llama"`` swaps the 3-leaf MLP for a
+    tiny LLaMA tree (12 leaves at 4 layers) where that gap is real.
+    ``prefetch=True`` (stage 3 only) describes
+    :func:`make_zero3_llama_train_step`: the gather site sits INSIDE the
+    layer scan — one all-gather per layer-bucket per trip, trip count ==
+    ``n_layers``, the double-buffered overlap shape.
     """
     from ddl25spring_tpu.parallel.dp import _tiny_mlp_workload
 
     n = mesh.shape[axis]
-    params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
+    key = jax.random.PRNGKey(0)
+    slack = 256
+    bb = bucketing.DEFAULT_BUCKET_BYTES if bucketed else None
+
+    if prefetch:
+        if stage != 3 or not bucketed:
+            raise ValueError("prefetch describes the bucketed stage-3 "
+                             "scanned-LLaMA step only")
+        cfg, params, _, tokens, param_bytes = _llama_workload(n)
+        L = cfg.n_layers
+        tx = optax.sgd(0.1)
+        shards = zero_shard_llama_params(params, mesh, axis)
+        step = make_zero3_llama_train_step(
+            cfg, tx, mesh, axis, prefetch=True,
+            per_shard_rng=False, donate=True,
+        )
+        shard_bytes = sum(
+            l.size * l.dtype.itemsize for l in jax.tree.leaves(shards)
+        )
+        layer_tmpl = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype),
+            params["blocks"],
+        )
+        n_lb = _row_plan(layer_tmpl, n, bb).n_buckets
+        outer_tmpl = {k: v for k, v in params.items() if k != "blocks"}
+        n_ob = _row_plan(outer_tmpl, n, bb).n_buckets
+        return {
+            "fn": step,
+            "args": (shards, tx.init(shards), tokens, key),
+            "lowered": "train_step",
+            "meta": {
+                "zero_stage": 3,
+                "prefetch": True,
+                "n_layers": L,
+                "param_bytes": param_bytes,
+                "n_param_leaves": len(jax.tree.leaves(params)),
+                "n_buckets": n_lb + n_ob,
+                "n_layer_buckets": n_lb,
+                "n_outer_buckets": n_ob,
+            },
+            "expected": {
+                "scalar_bytes": 64,
+                # the in-scan gather executes once per layer-bucket per
+                # trip (trip count == L-1, annotated on the while; the
+                # peeled last layer has nothing left to prefetch) plus
+                # the initial double-buffer fill and the outer gathers;
+                # the backward may re-play gathers, hence the x3 ceiling
+                "all-gather": {
+                    "min_count": n_lb * L + n_ob,
+                    "max_count": 3 * n_lb * L + 2 * n_ob,
+                    "axes": [axis],
+                },
+                "reduce-scatter": {
+                    "min_count": n_lb + n_ob,
+                    "axes": [axis],
+                },
+                "all-reduce": {"max_bytes": slack},
+                "forbidden": ["collective-permute", "all-to-all"],
+                # the compiled module is the per-DEVICE SPMD program, so
+                # the aliased bytes are one device's shard of the tree
+                "donation": {"min_saved_bytes": shard_bytes // n},
+                "memory": {"max_peak_hbm_bytes": 24 * 1024 * 1024},
+            },
+        }
+
+    if workload == "llama":
+        _, params, loss_fn, batch, param_bytes = _llama_workload(n)
+        mem_budget = 24 * 1024 * 1024
+    else:
+        params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
+        mem_budget = 4 * 1024 * 1024
     padded_bytes = sum(
         n * _leaf_meta(leaf, n)[1] * jnp.result_type(leaf).itemsize
         for leaf in jax.tree.leaves(params)
@@ -404,13 +884,16 @@ def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
     tx = optax.sgd(0.1)
     shards = zero_shard_params(params, mesh, axis)
     opt_state = tx.init(shards)
-    key = jax.random.PRNGKey(0)
     n_leaves = len(jax.tree.leaves(params))
-    slack = 256
+    n_buckets = _row_plan(params, n, bb).n_buckets if bucketed else None
+    # collective sites per sweep over the tree: one per bucket when
+    # packing, one per leaf otherwise
+    launches = n_buckets if bucketed else n_leaves
     if stage == 3:
         step = make_zero_dp_train_step(
             loss_fn, tx, mesh, params, axis,
             per_shard_rng=False, instrument=False,
+            bucket_bytes=bb, donate=True,
         )
         args = (shards, opt_state, batch, key)
         expected = {
@@ -419,22 +902,28 @@ def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
                 "min_bytes": padded_bytes,
                 "max_bytes": 2 * padded_bytes + slack,  # bwd may re-gather
                 "axes": [axis],
+                "min_count": launches,
+                "max_count": 2 * launches,
             },
             "reduce-scatter": {
                 "min_bytes": padded_bytes // n,
                 "max_bytes": padded_bytes // n + slack,
                 "axes": [axis],
-                "min_count": n_leaves,
+                "min_count": launches,
+                "max_count": launches,
             },
             # a param-sized all-reduce would mean the sharding collapsed
             # back to replicated DP
             "all-reduce": {"max_bytes": slack},
             "forbidden": ["collective-permute", "all-to-all"],
+            # per-DEVICE aliased bytes: stage 3's inputs are the [n, k]
+            # shards, of which this device holds 1/n
+            "donation": {"min_saved_bytes": padded_bytes // n},
         }
     else:
         step = make_zero_partitioned_train_step(
             loss_fn, tx, mesh, params, axis, stage=stage,
-            per_shard_rng=False,
+            per_shard_rng=False, bucket_bytes=bb, donate=True,
         )
         args = (params, opt_state, batch, key)
         expected = {
@@ -443,14 +932,19 @@ def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
                 "min_bytes": padded_bytes,
                 "max_bytes": padded_bytes + slack,
                 "axes": [axis],
+                "min_count": launches,
+                "max_count": launches,
             },
             "forbidden": ["collective-permute", "all-to-all"],
+            "donation": {"min_saved_bytes": param_bytes},
         }
         if stage == 1:
             expected["all-reduce"] = {
                 "min_bytes": padded_bytes,
                 "max_bytes": padded_bytes + slack,
                 "axes": [axis],
+                # + up to 2 scalar loss reductions ride along
+                "max_count": launches + 2,
             }
             expected["forbidden"].append("reduce-scatter")
         else:
@@ -458,18 +952,23 @@ def describe(mesh: Mesh, stage: int = 3, axis: str = "data"):
                 "min_bytes": padded_bytes // n,
                 "max_bytes": padded_bytes // n + slack,
                 "axes": [axis],
+                "min_count": launches,
+                "max_count": launches,
             }
             # stage 2's defining property: NO full-grad all-reduce
             expected["all-reduce"] = {"max_bytes": slack}
+    expected["memory"] = {"max_peak_hbm_bytes": mem_budget}
     return {
         "fn": step,
         "args": args,
         "lowered": "train_step",
         "meta": {
             "zero_stage": stage,
+            "workload": workload,
             "param_bytes": param_bytes,
             "padded_param_bytes": padded_bytes,
             "n_param_leaves": n_leaves,
+            **({"n_buckets": n_buckets} if bucketed else {}),
         },
         "expected": expected,
     }

@@ -203,6 +203,28 @@ def test_sp_tokenizer_runs_on_in_tree_artifact():
     assert tok.decode(body) == text
 
 
+def test_sp_tokenizer_warns_once_on_pure_python_fallback():
+    """Without the sentencepiece package the wrapper must SAY it swapped
+    in the approximate pure-Python processor (no NFKC, no byte-fallback
+    — see data/sp_model.py's divergence notes), not swap silently."""
+    import warnings
+
+    from ddl25spring_tpu.data.tokenizer import SentencePieceTokenizer
+
+    try:
+        import sentencepiece  # noqa: F401
+
+        pytest.skip("real sentencepiece installed; no fallback to warn on")
+    except ImportError:
+        pass
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        SentencePieceTokenizer("data/tinystories.model")
+    msgs = [str(w.message) for w in caught]
+    assert any("PySentencePieceProcessor" in m and "approximate" in m.lower()
+               for m in msgs), msgs
+
+
 def test_sp_tokenizer_via_env_discovery(monkeypatch):
     from ddl25spring_tpu.data.tokenizer import (
         SentencePieceTokenizer, get_tokenizer,

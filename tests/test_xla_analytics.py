@@ -274,6 +274,12 @@ def test_dp_signature_exactly_one_fused_gradient_allreduce():
     grad = r["meta"]["grad_bytes"]
     # all traffic is the gradient all-reduce (+ scalar loss reductions)
     assert grad <= _payload(r, "all-reduce") <= grad + 256
+    # bucketed: the non-scalar launches == the plan's bucket count
+    big = [
+        o for o in r["collectives"]["ops"]
+        if o["kind"] == "all-reduce" and o["result_bytes"] > 64
+    ]
+    assert sum(o["count"] for o in big) == r["meta"]["n_buckets"]
     for kind in ("all-gather", "reduce-scatter", "collective-permute",
                  "all-to-all"):
         assert _count(r, kind) == 0, f"plain DP grew a stray {kind}"
@@ -283,17 +289,20 @@ def test_dp_signature_exactly_one_fused_gradient_allreduce():
     )
 
 
-def test_zero3_signature_per_leaf_gathers_and_scatters():
+def test_zero3_signature_bucketed_gathers_and_scatters():
     r = _report("zero3")
     assert r["signature_violations"] == []
-    n_leaves = r["meta"]["n_param_leaves"]
+    n_buckets = r["meta"]["n_buckets"]
     padded = r["meta"]["padded_param_bytes"]
     n = r["mesh"]["data"]
-    # forward gathers the full padded params, once per leaf
-    assert _count(r, "all-gather") == n_leaves
+    assert n_buckets < r["meta"]["n_param_leaves"]
+    # forward gathers the full padded params, once per BUCKET (the
+    # O(n_leaves) -> O(n_buckets) collapse; per-leaf counts are pinned
+    # against this path in test_zero3_bucketing_collapses_llama_launches)
+    assert _count(r, "all-gather") == n_buckets
     assert _payload(r, "all-gather") == padded
-    # backward reduce-scatters the 1/n grad shards, once per leaf
-    assert _count(r, "reduce-scatter") == n_leaves
+    # backward reduce-scatters the 1/n grad shards, once per bucket
+    assert _count(r, "reduce-scatter") == n_buckets
     assert _payload(r, "reduce-scatter") == padded // n
     # NO param-sized all-reduce — that would be replicated DP again
     assert _payload(r, "all-reduce") <= 64
@@ -307,12 +316,81 @@ def test_zero_stage1_vs_stage2_collective_distinction():
     # stage 1: full-grad all-reduce, NO reduce-scatter
     assert _payload(r1, "all-reduce") >= padded
     assert _count(r1, "reduce-scatter") == 0
-    # stage 2: grads reduce-scatter straight to shards, NO full all-reduce
-    assert _count(r2, "reduce-scatter") == r2["meta"]["n_param_leaves"]
+    # stage 2: grads reduce-scatter straight to shards (one launch per
+    # bucket), NO full all-reduce
+    assert _count(r2, "reduce-scatter") == r2["meta"]["n_buckets"]
     assert _payload(r2, "all-reduce") <= 64
     # both all-gather the updated params back to replicas
     for r in (r1, r2):
         assert _payload(r, "all-gather") == padded
+        assert _count(r, "all-gather") == r["meta"]["n_buckets"]
+
+
+def test_zero3_bucketing_collapses_llama_launches():
+    """The tentpole's machine-checkable core: on a param tree with a
+    realistic leaf count (tiny LLaMA, 12 leaves), the bucketed ZeRO-3
+    step launches O(n_buckets) collectives — strictly fewer than the
+    per-leaf path's O(n_leaves) — while moving the same padded bytes."""
+    bucketed = xa.compile_strategy("zero3", workload="llama")
+    per_leaf = xa.compile_strategy(
+        "zero3", workload="llama", bucketed=False
+    )
+    assert "error" not in bucketed and "error" not in per_leaf
+    assert bucketed["signature_violations"] == []
+    assert per_leaf["signature_violations"] == []
+    n_leaves = per_leaf["meta"]["n_param_leaves"]
+    n_buckets = bucketed["meta"]["n_buckets"]
+    assert n_buckets < n_leaves
+    for kind in ("all-gather", "reduce-scatter"):
+        assert _count(per_leaf, kind) == n_leaves
+        assert _count(bucketed, kind) == n_buckets
+        assert _count(bucketed, kind) < _count(per_leaf, kind)
+        # same padded payload rides fewer launches
+        assert _payload(bucketed, kind) == _payload(per_leaf, kind)
+
+
+def test_zero3_prefetch_gather_rides_the_layer_scan():
+    """Leg-2 pin: the scanned-LLaMA prefetch step's parameter all-gather
+    sits INSIDE the layer while-loop (trip count == n_layers, annotated
+    by XLA) — one launch per layer-bucket per trip plus the initial
+    double-buffer fill — instead of one up-front whole-tree gather."""
+    r = _report("zero3-prefetch")
+    assert r["signature_violations"] == []
+    assert r["lowered"] == "train_step"
+    L = r["meta"]["n_layers"]
+    n_lb = r["meta"]["n_layer_buckets"]
+    n_ob = r["meta"]["n_outer_buckets"]
+    in_loop = [
+        o for o in r["collectives"]["ops"]
+        if o["kind"] == "all-gather" and o["count"] >= L - 1
+    ]
+    assert in_loop and all(o["trip_known"] for o in in_loop)
+    # forward issues: L-1 in-scan (the peeled last layer prefetches
+    # nothing) + 1 initial fill per layer-bucket, plus the outer
+    # (embed/ln_f/unembed) gathers — exactly one gather per layer
+    assert _count(r, "all-gather") == n_lb * L + n_ob
+    # the backward reduce-scatters every layer's grads
+    assert _count(r, "reduce-scatter") >= n_lb * (L - 1)
+    assert _payload(r, "all-reduce") <= 64  # never collapses to DP
+
+
+def test_strategy_reports_pin_memory_budgets_and_donation():
+    """Satellite pins: every describe() that declares a peak-HBM budget
+    or a donation floor is enforced through signature_violations (so an
+    HBM regression fails tier-1 like a comms regression), and the
+    donated builds alias a nonzero byte count on this backend."""
+    for name in ("dp", "zero1", "zero2", "zero3", "zero3-prefetch", "ep"):
+        r = _report(name)
+        assert r["signature_violations"] == []
+        assert "memory" in r["expected"], name
+        assert "donation" in r["expected"], name
+        assert r["memory"]["peak_hbm_bytes"] <= (
+            r["expected"]["memory"]["max_peak_hbm_bytes"]
+        )
+        assert r["donation"]["hbm_saved_bytes"] >= (
+            r["expected"]["donation"]["min_saved_bytes"]
+        )
+        assert r["donation"]["hbm_saved_bytes"] > 0
 
 
 def test_pipeline_signature_ticks_times_permutes():

@@ -74,6 +74,12 @@ def format_strategy_report(r: dict) -> str:
     if not totals:
         lines.append("  (no collectives — single-shard program)")
 
+    meta = r.get("meta") or {}
+    if meta.get("n_buckets") is not None:
+        lines.append(
+            f"  flat-bucket packing: {meta['n_buckets']} bucket(s) over "
+            f"{meta.get('n_param_leaves', '?')} param leaves"
+        )
     mem = r.get("memory")
     if mem:
         lines.append(
@@ -82,6 +88,19 @@ def format_strategy_report(r: dict) -> str:
             f"temps {_fmt_bytes(mem.get('temp_size_in_bytes', 0))}, "
             f"out {_fmt_bytes(mem.get('output_size_in_bytes', 0))})"
         )
+    don = r.get("donation") or {}
+    saved = don.get("hbm_saved_bytes", 0)
+    if saved:
+        lines.append(f"  donated (aliased in place): {_fmt_bytes(saved)}/chip")
+    elif r.get("lowered") != "train_step":
+        lines.append(f"  donated (aliased in place): n/a — lowers "
+                     f"{r.get('lowered', '?')}, no aliasable outputs")
+    elif not mem or "alias_size_in_bytes" not in mem:
+        lines.append("  donated (aliased in place): unknown — no aliasing "
+                     "stats on this backend")
+    else:
+        lines.append("  donated (aliased in place): none — step compiled "
+                     "undonated")
     if r.get("flops"):
         lines.append(f"  flops/step (cost analysis): {r['flops']:.3e}")
     proj = r.get("projection") or {}
