@@ -104,8 +104,47 @@ def attach_parent_telemetry(
         tel["retry_failures"] = failures
     if compile_report is not None:
         tel["compile_report"] = compile_report
+        tel["lint"] = lint_summary(compile_report)
     record["telemetry"] = tel
     return record
+
+
+def lint_summary(compile_report: dict) -> dict:
+    """Condense the per-strategy hazard findings the compile report
+    carries (``ddl25spring_tpu/analysis``) into the BENCH line's lint
+    cell: total/unwaived counts, the worst unwaived severity, a count of
+    strategies the linter could NOT judge (compile/lint errors — never
+    conflated with "clean"), and a per-strategy breakdown — next to the
+    compile report so a dead-TPU run still states the judgment, not
+    just the inventory."""
+    from ddl25spring_tpu.analysis.engine import summarize
+    from ddl25spring_tpu.analysis.rules import severity_rank
+
+    per: dict = {}
+    worst = None
+    total = unwaived = errors = 0
+    for name, r in (compile_report.get("strategies") or {}).items():
+        if "findings" not in r:
+            # a strategy the linter never judged must not read as clean:
+            # record WHY (compile error / lint crash) and count it
+            err = r.get("lint_error") or r.get("error")
+            if err is not None:
+                errors += 1
+                per[name] = {"error": str(err)}
+            continue
+        s = summarize(r["findings"])
+        per[name] = {k: s[k] for k in ("findings", "unwaived", "worst")}
+        total += s["findings"]
+        unwaived += s["unwaived"]
+        if severity_rank(s["worst"]) > severity_rank(worst):
+            worst = s["worst"]
+    return {
+        "findings": total,
+        "unwaived": unwaived,
+        "worst": worst,
+        "errors": errors,
+        "per_strategy": per,
+    }
 
 
 def run_with_retries(

@@ -1,0 +1,162 @@
+"""Waivers: justified exceptions to hazard findings, kept in TOML.
+
+``analysis/waivers.toml`` is the single waiver file for both finding
+families (HLO rules H*, source rules S*).  Each entry must carry a
+``reason`` — an unexplained waiver is itself a finding (W000).  Schema::
+
+    [[waiver]]
+    rule = "S102"                       # required: exact rule id
+    strategy = "zero3*"                 # optional fnmatch vs finding.strategy
+    path = "ddl25spring_tpu/p*.py"      # optional fnmatch vs finding.source path
+    symbol = "describe"                 # optional substring vs finding.op
+    match = "loop-invariant"            # optional substring vs finding.message
+    reason = "why this is fine here"    # required
+
+A waiver applies when every field it specifies matches; unspecified
+fields match everything.  Waived findings stay in every report (marked
+``waived`` with the reason) — waivers silence the CI gate, not the
+evidence.
+
+Parsing: stdlib ``tomllib`` on Python >= 3.11, else a deliberately tiny
+fallback parser covering exactly the schema above (tables of string
+keys) — the build image runs 3.10 and the repo adds no dependencies.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from fnmatch import fnmatch
+from typing import Any
+
+from ddl25spring_tpu.analysis.rules import Finding
+
+DEFAULT_WAIVERS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "waivers.toml"
+)
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule: str
+    reason: str
+    strategy: str | None = None
+    path: str | None = None
+    symbol: str | None = None
+    match: str | None = None
+
+    def covers(self, f: Finding) -> bool:
+        if self.rule != f.rule:
+            return False
+        if self.strategy is not None and not fnmatch(
+            f.strategy or "", self.strategy
+        ):
+            return False
+        if self.path is not None:
+            # S-rule sources are repo-relative; H-rule sources carry the
+            # ABSOLUTE path from HLO source_file metadata — accept a
+            # repo-relative pattern against either spelling
+            src_path = (f.source or "").rsplit(":", 1)[0]
+            if not (
+                fnmatch(src_path, self.path)
+                or fnmatch(src_path, "*/" + self.path)
+            ):
+                return False
+        if self.symbol is not None and self.symbol not in (f.op or ""):
+            return False
+        if self.match is not None and self.match not in f.message:
+            return False
+        return True
+
+
+def _parse_toml_text(text: str) -> dict[str, Any]:
+    try:
+        import tomllib  # Python >= 3.11
+
+        return tomllib.loads(text)
+    except ModuleNotFoundError:
+        return _parse_mini(text)
+
+
+def _parse_mini(text: str) -> dict[str, Any]:
+    """The fallback parser: ``[[waiver]]`` array-of-tables whose values
+    are double-quoted strings.  Anything fancier is a loud error — the
+    file should be simplified, not the parser grown."""
+    doc: dict[str, Any] = {}
+    cur: dict[str, str] | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[[") and line.endswith("]]"):
+            name = line[2:-2].strip()
+            cur = {}
+            doc.setdefault(name, []).append(cur)
+            continue
+        if "=" in line and cur is not None:
+            key, _, val = line.partition("=")
+            key, val = key.strip(), val.strip()
+            if val.startswith('"'):
+                end = val.find('"', 1)
+                while end > 0 and val[end - 1] == "\\":
+                    end = val.find('"', end + 1)
+                if end < 0:
+                    raise ValueError(
+                        f"waivers.toml:{lineno}: unterminated string"
+                    )
+                # after the closing quote only a comment may follow —
+                # anything else is a malformed entry that would silently
+                # widen the waiver (and diverge from tomllib on 3.11)
+                rest = val[end + 1:].strip()
+                if rest and not rest.startswith("#"):
+                    raise ValueError(
+                        f"waivers.toml:{lineno}: unexpected content "
+                        f"after string value: {rest!r}"
+                    )
+                cur[key] = val[1:end].replace('\\"', '"')
+                continue
+        raise ValueError(
+            f"waivers.toml:{lineno}: only [[table]] headers and "
+            f'key = "string" lines are supported, got: {line!r}'
+        )
+    return doc
+
+
+def load_waivers(path: str | None = None) -> list[Waiver]:
+    """Load waivers from ``path`` (default: the repo's
+    ``analysis/waivers.toml``).  A missing file is an empty waiver set;
+    an entry without ``rule``/``reason`` raises (the file IS the audit
+    trail — incomplete entries defeat it)."""
+    path = path or DEFAULT_WAIVERS_PATH
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = _parse_toml_text(f.read())
+    out = []
+    for i, entry in enumerate(doc.get("waiver", [])):
+        if not entry.get("rule") or not entry.get("reason"):
+            raise ValueError(
+                f"{path}: waiver #{i + 1} needs both 'rule' and 'reason'"
+            )
+        known = {"rule", "reason", "strategy", "path", "symbol", "match"}
+        unknown = set(entry) - known
+        if unknown:
+            raise ValueError(
+                f"{path}: waiver #{i + 1} has unknown keys {sorted(unknown)}"
+            )
+        out.append(Waiver(**{k: entry[k] for k in known & set(entry)}))
+    return out
+
+
+def apply_waivers(
+    findings: list[Finding], waivers: list[Waiver]
+) -> list[Finding]:
+    """Mark each finding covered by a waiver (first match wins).  The
+    list is returned for chaining; findings mutate in place."""
+    for f in findings:
+        for w in waivers:
+            if w.covers(f):
+                f.waived = True
+                f.waived_reason = w.reason
+                break
+    return findings

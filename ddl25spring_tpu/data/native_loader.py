@@ -134,7 +134,10 @@ class NativeCifar10Loader:
             self._handle = None
 
     def __del__(self):
-        try:
+        # no contextlib.suppress here: at interpreter teardown the module
+        # globals may already be cleared, and a finalizer must not do
+        # global lookups before reaching the native free
+        try:  # noqa: SIM105
             self.close()
         except Exception:
             pass

@@ -186,10 +186,14 @@ class BpeTokenizer:
         native = getattr(self, "_native", None)
         if native is not None:
             lib, handle = native
-            try:
+            # bare try/except, not contextlib.suppress: at interpreter
+            # teardown module globals may be cleared and a finalizer
+            # must not do global lookups before the native free
+            try:  # noqa: SIM105
                 lib.bpe_destroy(handle)
-            except Exception:  # interpreter teardown: lib may be gone
+            except Exception:
                 pass
+
 
     # ------------------------------------------------------------ training
     @classmethod

@@ -42,6 +42,14 @@ DEFAULT_STRATEGIES = (
 )
 
 
+def parse_mesh_arg(mesh: str | None) -> tuple[int, ...] | None:
+    """The shared ``--mesh 2x4`` CLI syntax (positional onto a
+    strategy's axis names; extras fold into the last axis)."""
+    if not mesh:
+        return None
+    return tuple(int(x) for x in mesh.lower().split("x"))
+
+
 def build_compile_report(
     strategies: tuple[str, ...] | list[str] | None = None,
     mesh_sizes: tuple[int, ...] | None = None,
@@ -114,7 +122,8 @@ def bench_compile_report(
             )
             compiled = step.lower(params, opt_state, raw).compile()
             mesh = meta["mesh"]
-            r = xla_analytics.analyze_compiled(compiled, mesh, meta={
+            hlo_text = compiled.as_text()
+            r = xla_analytics.analyze_compiled(compiled, mesh, hlo_text=hlo_text, meta={
                 "layout": meta["layout"],
                 "topology": meta["topology"],
                 "n_chips": meta["n_chips"],
@@ -126,6 +135,15 @@ def bench_compile_report(
                 for ax, s in zip(mesh.axis_names, mesh.devices.shape)
             }
             r["lowered"] = "train_step"
+            r["donation"]["donatable_leaves"] = len(
+                jax.tree.leaves((params, opt_state))
+            )
+            # hazard findings ride the report into the BENCH line's
+            # telemetry, so a dead-TPU run still says e.g. "44 MiB sync
+            # all-reduce, no overlap" about the exact program it ran
+            xla_analytics.attach_findings(
+                r, compiled, strategy=name, hlo_text=hlo_text
+            )
             return r
         except Exception as e:  # noqa: BLE001 — degrade per entry
             return {"strategy": name, "error": f"{type(e).__name__}: {e}"}
@@ -222,10 +240,7 @@ def main(argv=None) -> int:
                     help="also write DIR/compile_report.json")
     args = ap.parse_args(argv)
 
-    mesh_sizes = (
-        tuple(int(x) for x in args.mesh.lower().split("x"))
-        if args.mesh else None
-    )
+    mesh_sizes = parse_mesh_arg(args.mesh)
     if args.bench:
         report = bench_compile_report()
     else:
@@ -242,10 +257,7 @@ def main(argv=None) -> int:
 
 if __name__ == "__main__":
     # CPU-only, multi-device fake host — decided before any backend init
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    from ddl25spring_tpu.utils.platform import ensure_cpu_tools_env
+
+    ensure_cpu_tools_env()
     sys.exit(main())

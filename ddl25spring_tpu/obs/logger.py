@@ -98,7 +98,10 @@ class MetricsLogger:
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
         self.path = os.path.join(run_dir, filename)
-        self._f = open(self.path, "w" if meta is not None else "a")
+        # long-lived handle, closed in close()/__exit__ — not a with-block
+        self._f = open(  # noqa: SIM115
+            self.path, "w" if meta is not None else "a"
+        )
         self._n = 0
         if meta is not None:
             self._write(dict(meta, record=meta.get("record", "header")))

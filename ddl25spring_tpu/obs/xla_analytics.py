@@ -162,6 +162,141 @@ def _execution_multipliers(
     return mult, known
 
 
+def _op_name_of_line(line: str) -> str | None:
+    """The ``%name`` an HLO instruction line defines (sans ``%``)."""
+    m = re.match(r"(?:ROOT\s+)?%([\w.\-]+)\s*=", line)
+    return m.group(1) if m else None
+
+
+def _operand_names(line: str, open_paren: int) -> list[str]:
+    """``%``-operand references inside the balanced-paren argument list
+    starting at ``line[open_paren]`` (skips attribute references like
+    ``to_apply=%add`` that sit after the closing paren)."""
+    depth = 0
+    end = len(line)
+    for i in range(open_paren, len(line)):
+        c = line[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    return re.findall(r"%([\w.\-]+)", line[open_paren:end])
+
+
+def parse_op_defs(hlo_text: str) -> dict[str, dict[str, dict[str, Any]]]:
+    """Per-computation def table: ``{comp_name: {op_name: def}}`` where
+    each def is ``{"opcode", "type", "operands", "root", "line"}``.
+
+    This is the substrate the hazard rules walk — e.g. "is this f32
+    collective fed by a bf16 ``convert``" (H004) or "does an all-gather
+    feed a reduce-scatter" (H002) are producer-chain questions over
+    these defs (:mod:`ddl25spring_tpu.analysis.engine`).
+    """
+    comps, _entry = _split_computations(hlo_text)
+    out: dict[str, dict[str, dict[str, Any]]] = {}
+    for comp in comps.values():
+        defs: dict[str, dict[str, Any]] = {}
+        for line in comp.lines:
+            name = _op_name_of_line(line)
+            if name is None:
+                continue
+            rhs = line.split("=", 1)[1].strip()
+            # result type: a tuple type spans balanced parens; otherwise
+            # it's the first space-free token
+            if rhs.startswith("("):
+                depth = 0
+                tend = 0
+                for i, c in enumerate(rhs):
+                    if c == "(":
+                        depth += 1
+                    elif c == ")":
+                        depth -= 1
+                        if depth == 0:
+                            tend = i + 1
+                            break
+                type_str, rest = rhs[:tend], rhs[tend:].lstrip()
+            else:
+                type_str, _, rest = rhs.partition(" ")
+            om = re.match(r"([\w.\-]+)\(", rest)
+            if not om:
+                continue
+            opcode = om.group(1)
+            paren = line.find(rest, line.index("=")) + om.end() - 1
+            defs[name] = {
+                "opcode": opcode,
+                "type": type_str,
+                "operands": _operand_names(line, paren),
+                "root": line.startswith("ROOT "),
+                "line": line,
+            }
+        out[comp.name] = defs
+    return out
+
+
+def parse_input_output_aliases(hlo_text: str) -> list[dict[str, Any]]:
+    """Entries of the module-level ``input_output_alias`` table — the
+    buffers XLA reuses in place (donated params/opt-state).  Each entry:
+    ``{"output_index": [...], "param_number": int, "param_index": [...],
+    "kind": "may-alias"|"must-alias"}``.  Empty list = nothing donated.
+    """
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    i = hlo_text.index("{", start)
+    depth = 0
+    for j in range(i, len(hlo_text)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    block = hlo_text[i:j + 1]
+    out = []
+    for m in re.finditer(
+        r"\{([\d,\s]*)\}:\s*\((\d+)\s*,\s*\{([\d,\s]*)\}\s*,?\s*([\w\-]*)\)",
+        block,
+    ):
+        out.append({
+            "output_index": [int(x) for x in m.group(1).split(",") if x.strip()],
+            "param_number": int(m.group(2)),
+            "param_index": [int(x) for x in m.group(3).split(",") if x.strip()],
+            "kind": m.group(4) or "may-alias",
+        })
+    return out
+
+
+def parse_entry_parameters(hlo_text: str) -> list[dict[str, Any]]:
+    """The entry computation's parameters: ``{"number", "name", "bytes",
+    "type", "arg"}`` per input buffer, where ``arg`` is the jax-level
+    argument path XLA records in the op metadata (``params['w1']``,
+    ``opt_state[0]...``, ``batch[0]``) when available — the names the
+    donation-miss rule (H005) reports."""
+    comps, entry = _split_computations(hlo_text)
+    if entry is None:
+        return []
+    out = []
+    for line in comps[entry].lines:
+        m = re.match(
+            r"(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.+?)\s*parameter\((\d+)\)", line
+        )
+        if not m:
+            continue
+        arg = re.search(r'op_name="([^"]+)"', line)
+        out.append({
+            "number": int(m.group(3)),
+            "name": m.group(1),
+            "bytes": _shape_bytes(m.group(2)),
+            "type": m.group(2),
+            "arg": arg.group(1) if arg else None,
+        })
+    out.sort(key=lambda p: p["number"])
+    return out
+
+
 def _parse_groups(line: str) -> list[list[int]] | None:
     """Device groups of a collective op line.  Handles the explicit
     ``replica_groups={{0,1},{2,3}}`` form and (best-effort) the newer
@@ -268,9 +403,14 @@ def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
 
     Returns one record per op *site*: ``{kind, result_bytes, count``
     (executions per call, loop trip counts folded in), ``trip_known,
-    axes, group_size, wire_bytes`` (per execution), ``source}``.
-    ``axes`` needs ``mesh`` (a ``jax.sharding.Mesh`` whose device ids
-    match the compiled program); without it axes are ``None``.
+    axes, group_size, wire_bytes`` (per execution), ``source, name,
+    computation, operands, pairs, async}``.  ``async`` is True for
+    ``-start``/``-done`` pairs (the op overlaps with compute); ``pairs``
+    carries a collective-permute's raw source-target pairs; ``name`` /
+    ``computation`` / ``operands`` anchor the op in the def tables of
+    :func:`parse_op_defs` for the hazard rules.  ``axes`` needs ``mesh``
+    (a ``jax.sharding.Mesh`` whose device ids match the compiled
+    program); without it axes are ``None``.
     """
     comps, entry = _split_computations(hlo_text)
     mult, known = _execution_multipliers(comps, entry)
@@ -301,6 +441,7 @@ def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
                 if mesh is not None:
                     axes = _axes_of_pairs(pairs, mesh)
             src = re.search(r'source_file="([^"]+)".*?source_line=(\d+)', line)
+            open_paren = line.index("(", cm.start())
             out.append({
                 "kind": kind,
                 "result_bytes": result_bytes,
@@ -310,6 +451,11 @@ def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
                 "group_size": group_size,
                 "wire_bytes": _wire_bytes(kind, result_bytes, group_size),
                 "source": f"{src.group(1)}:{src.group(2)}" if src else None,
+                "name": _op_name_of_line(line),
+                "computation": comp.name,
+                "operands": _operand_names(line, open_paren),
+                "pairs": pairs,
+                "async": bool(cm.group(2)),
             })
     return out
 
@@ -349,6 +495,7 @@ def analyze_compiled(
     compiled: Any,
     mesh=None,
     meta: dict[str, Any] | None = None,
+    hlo_text: str | None = None,
 ) -> dict[str, Any]:
     """Full compile-time report for one compiled XLA program: collective
     inventory (+ per-axis totals), memory footprint, FLOP totals, and
@@ -359,7 +506,11 @@ def analyze_compiled(
         compiled_memory_stats,
     )
 
-    ops = parse_hlo_collectives(compiled.as_text(), mesh)
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    ops = parse_hlo_collectives(hlo_text, mesh)
+    aliases = parse_input_output_aliases(hlo_text)
+    entry_params = parse_entry_parameters(hlo_text)
     memory = compiled_memory_stats(compiled)
     cost = compiled_cost_analysis(compiled)
     flops = float(cost.get("flops", 0.0)) if cost else None
@@ -375,10 +526,15 @@ def analyze_compiled(
         },
         "memory": memory,
         # buffer-donation accounting: the bytes the compiled program
-        # aliases in place instead of double-buffering (0 = undonated)
+        # aliases in place instead of double-buffering (0 = undonated);
+        # aliased_params are the entry-parameter numbers the alias table
+        # covers (the donation-miss hazard rule diffs these against the
+        # donatable inputs — analysis/rules.py H005)
         "donation": {
             "hbm_saved_bytes": (memory or {}).get("alias_size_in_bytes", 0),
+            "aliased_params": sorted({a["param_number"] for a in aliases}),
         },
+        "entry_params": entry_params,
         "flops": flops if flops and flops > 0 else None,
         "bytes_accessed": bytes_accessed,
         "projection": roofline_projection(
@@ -643,22 +799,28 @@ def describe_strategy(
 def compile_strategy(
     name: str,
     mesh_sizes: tuple[int, ...] | None = None,
+    lint: bool = True,
     **overrides: Any,
 ) -> dict[str, Any]:
     """Lower + compile one strategy on a fake CPU mesh and analyze it.
 
     Returns the :func:`analyze_compiled` report extended with
     ``{"strategy", "mesh", "lowered", "expected",
-    "signature_violations"}``.  A strategy whose trace/compile fails on
-    this jax (e.g. the homogeneous-pipeline grad path pre-VMA) degrades
-    to ``{"strategy", "error"}`` instead of raising — a dead strategy
-    must not cost the others' reports.
+    "signature_violations", "findings"}`` — the last from the static
+    hazard analyzer (:mod:`ddl25spring_tpu.analysis`), run over the same
+    optimized HLO unless ``lint=False``.  A strategy whose trace/compile
+    fails on this jax (e.g. the homogeneous-pipeline grad path pre-VMA)
+    degrades to ``{"strategy", "error"}`` instead of raising — a dead
+    strategy must not cost the others' reports.
     """
     try:
         mesh = strategy_mesh(name, mesh_sizes)
         d = describe_strategy(name, mesh, **overrides)
         compiled = d["fn"].lower(*d["args"]).compile()
-        report = analyze_compiled(compiled, mesh, meta=d.get("meta"))
+        hlo_text = compiled.as_text()  # serialized once, analyze + lint
+        report = analyze_compiled(
+            compiled, mesh, meta=d.get("meta"), hlo_text=hlo_text
+        )
     except Exception as e:  # noqa: BLE001 — degrade per strategy
         err: dict[str, Any] = {
             "strategy": name,
@@ -677,8 +839,48 @@ def compile_strategy(
         ax: int(s) for ax, s in zip(mesh.axis_names, mesh.devices.shape)
     }
     report["lowered"] = d.get("lowered", "train_step")
+    if report["lowered"] == "train_step":
+        # which leading entry parameters COULD have been donated: the
+        # flattened leaves of (params, opt_state) — donate_argnums=(0, 1)
+        # territory.  The donation-miss rule (H005) checks each of these
+        # above its byte threshold against the alias table.
+        import jax
+
+        report["donation"]["donatable_leaves"] = len(
+            jax.tree.leaves(d["args"][:2])
+        )
     expected = d.get("expected")
     if expected:
         report["expected"] = expected
         report["signature_violations"] = check_signature(report, expected)
+    if lint:
+        attach_findings(report, compiled, strategy=name, hlo_text=hlo_text)
+    return report
+
+
+def attach_findings(
+    report: dict[str, Any],
+    compiled: Any,
+    strategy=None,
+    hlo_text: str | None = None,
+):
+    """Run the static hazard analyzer over a compiled program and attach
+    its (waiver-resolved) findings to the report as ``report["findings"]``
+    (a list of dicts).  Pass ``hlo_text`` when the module text is already
+    in hand (``compiled.as_text()`` re-serializes the whole program).
+    Lint breakage degrades to ``report["lint_error"]`` — the analytics
+    must never cost the report itself."""
+    try:
+        from ddl25spring_tpu.analysis import engine
+
+        report["findings"] = [
+            f.to_dict()
+            for f in engine.lint_hlo_text(
+                hlo_text if hlo_text is not None else compiled.as_text(),
+                report=report,
+                strategy=strategy,
+            )
+        ]
+    except Exception as e:  # noqa: BLE001 — degrade, keep the report
+        report["lint_error"] = f"{type(e).__name__}: {e}"
     return report

@@ -36,7 +36,6 @@ overriding the per-leaf packed size (``sizes=`` = the padded row length
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
 import jax
@@ -57,8 +56,15 @@ def donation_default() -> bool:
     (correctly) invalidates.  Donation-specific tests and every
     ``describe()`` compile-analytics hook pass ``donate=True``
     explicitly, so the pinned programs are the donated ones.
+
+    The env read itself lives in :func:`~ddl25spring_tpu.utils.config.env_flag`
+    — the one sanctioned env boundary — so this module (which builds
+    traced computations) carries no ``os.environ`` dependency of its own
+    (``graft_lint`` rule S101).
     """
-    return os.environ.get("DDL25_DONATE", "1") not in ("", "0")
+    from ddl25spring_tpu.utils.config import env_flag
+
+    return env_flag("DDL25_DONATE", default=True)
 
 
 def donate_argnums(donate: bool | None) -> tuple[int, ...]:

@@ -364,12 +364,11 @@ def make_pipeline_loss(
                 "seq_axis rides the plain (num_chunks=1) gpipe schedule"
             )
         _check_sp(cfg, mesh, seq_axis, sp_mode, tp_axis)
-    if V > 1:
-        if M % S:
-            raise ValueError(
-                f"interleaved schedule needs microbatches ({M}) divisible "
-                f"by stages ({S})"
-            )
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({M}) divisible "
+            f"by stages ({S})"
+        )
     if tp_axis is not None:
         _check_tp(cfg, mesh, tp_axis)
 

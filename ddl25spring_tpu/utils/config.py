@@ -78,3 +78,23 @@ class FlConfig:
 
 def replace(cfg, **kw):
     return dataclasses.replace(cfg, **kw)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Read a boolean ``DDL25_*`` switch from the process environment.
+
+    This is the sanctioned env boundary for every runtime toggle the
+    library honors: modules that build traced computations must not read
+    ``os.environ`` themselves (``tools/graft_lint.py`` rule S101 — a
+    compiled program's structure silently depending on ambient process
+    state is exactly the hazard class the linter exists for) and instead
+    route through here, so every env-dependent default is greppable in
+    one place.  Unset -> ``default``; ``""``/``"0"``/``"false"`` ->
+    False; anything else -> True.
+    """
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw not in ("", "0", "false")

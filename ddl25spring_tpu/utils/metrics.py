@@ -18,6 +18,18 @@ from contextlib import contextmanager
 from dataclasses import asdict, dataclass, field
 
 ETA = "\N{GREEK SMALL LETTER ETA}"
+
+
+def fmt_bytes(b: float | None) -> str:
+    """Human bytes (1.5 KiB / 44.7 MiB) — the one formatter the report
+    tables and hazard findings share."""
+    if b is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} GiB"
 INF = "\N{INFINITY}"
 
 

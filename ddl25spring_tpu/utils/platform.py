@@ -32,7 +32,8 @@ def force_cpu_devices(n: int) -> None:
     if m and int(m.group(1)) != n:
         warnings.warn(
             f"XLA_FLAGS already sets {_FLAG}={m.group(1)}; overriding with "
-            f"the requested {n}"
+            f"the requested {n}",
+            stacklevel=2,
         )
         flags = re.sub(rf"{_FLAG}=\d+", f"{_FLAG}={n}", flags)
         os.environ["XLA_FLAGS"] = flags
@@ -42,3 +43,19 @@ def force_cpu_devices(n: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_cpu_tools_env(n: int = 8) -> None:
+    """Module preamble shared by the CPU-only analysis tools
+    (``tools/comms_report.py``, ``tools/graft_lint.py``,
+    ``obs/compile_report.py``): default to a CPU backend with an
+    ``n``-device fake host, RESPECTING any count already configured
+    (unlike :func:`force_cpu_devices`, which overrides — tools defer to
+    the caller's environment).  Callers still run
+    ``jax.config.update("jax_platforms", "cpu")`` in main(): on images
+    whose sitecustomize registers a TPU plugin at interpreter start the
+    env var alone is ignored."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _FLAG.lstrip("-") not in flags:
+        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n}").strip()

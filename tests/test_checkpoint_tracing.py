@@ -43,13 +43,13 @@ def test_kill_and_resume_equivalence(tmp_path, train_setup, devices8):
 
     # uninterrupted run: 6 steps
     p_ref, o_ref = params, tx.init(params)
-    for i in range(6):
+    for _ in range(6):
         p_ref, o_ref, _ = step(p_ref, o_ref, batch, key)
 
     # interrupted run: 3 steps, checkpoint, "crash", restore, 3 more
     ckpt = Checkpointer(tmp_path / "ckpt")
     p, o = params, tx.init(params)
-    for i in range(3):
+    for _ in range(3):
         p, o, _ = step(p, o, batch, key)
     ckpt.save(2, {"params": p, "opt_state": o})
     ckpt.close()  # saves are async; the barrier stands in for process exit
@@ -64,7 +64,7 @@ def test_kill_and_resume_equivalence(tmp_path, train_setup, devices8):
     )
     assert next_step == 3
     p, o = restored["params"], restored["opt_state"]
-    for i in range(3):
+    for _ in range(3):
         p, o, _ = step(p, o, batch, key)
 
     jax.tree.map(

@@ -1,0 +1,231 @@
+"""Direct unit coverage for the ``utils/compat.py`` shims.
+
+The shims are the single import point that lets the whole stack (written
+against current jax: top-level ``shard_map``, VMA ``pcast``, one-dict
+``cost_analysis``, peak-carrying ``memory_analysis``) import and run on
+jax 0.4.x.  They were previously exercised only through the modules that
+use them; these tests pin each shim's contract on BOTH API vintages —
+every assertion here is phrased so it passes on the legacy runtime this
+image ships AND on a current one.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ddl25spring_tpu.utils import compat
+from ddl25spring_tpu.utils.compat import (
+    HAS_VMA,
+    compiled_cost_analysis,
+    compiled_memory_stats,
+    pcast,
+    shard_map,
+    typeof,
+)
+from ddl25spring_tpu.utils.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh4(devices8):
+    return make_mesh(devices8[:4], data=4)
+
+
+# ------------------------------------------------------------- shard_map
+
+
+def test_shard_map_direct_call_runs_psum(mesh4):
+    @functools.partial(
+        shard_map, mesh=mesh4, in_specs=(P("data"),), out_specs=P()
+    )
+    def total(x):
+        return lax.psum(jnp.sum(x), "data")
+
+    out = total(jnp.arange(8.0))
+    assert float(out) == pytest.approx(28.0)
+
+
+def test_shard_map_partial_decorator_form(mesh4):
+    """The ``shard_map(f=None, **kw)`` curry: usable exactly like the
+    real API's decorator spelling."""
+    deco = shard_map(mesh=mesh4, in_specs=(P("data"),), out_specs=P("data"))
+    assert callable(deco)
+    doubled = deco(lambda x: x * 2)
+    np.testing.assert_array_equal(
+        np.asarray(doubled(jnp.arange(4.0))), [0.0, 2.0, 4.0, 6.0]
+    )
+
+
+def test_shard_map_legacy_flag_matches_runtime():
+    """On pre-VMA jax the shim must route through the experimental API
+    with check_rep defaulted off; on current jax it must NOT inject the
+    (removed) kwarg.  _LEGACY is the single switch for both."""
+    legacy_runtime = not hasattr(jax, "shard_map")
+    assert compat._LEGACY == legacy_runtime
+
+
+# ----------------------------------------------------------------- pcast
+
+
+def test_pcast_is_identity_semantics(mesh4):
+    """pcast never changes VALUES — on VMA jax it only retypes the aval,
+    pre-VMA it is literally identity (nothing to cast between)."""
+    @functools.partial(
+        shard_map, mesh=mesh4, in_specs=(P("data"),), out_specs=P("data")
+    )
+    def body(x):
+        return pcast(x, "data", to="varying") + 1.0
+
+    np.testing.assert_array_equal(
+        np.asarray(body(jnp.zeros(4))), np.ones(4)
+    )
+
+
+def test_pcast_binding_tracks_vma():
+    if HAS_VMA:
+        assert pcast is lax.pcast
+    else:
+        x = jnp.arange(3.0)
+        assert pcast(x, "data", to="varying") is x
+
+
+def test_typeof_exposes_shape_dtype():
+    t = typeof(jnp.zeros((2, 3), jnp.float32))
+    assert tuple(t.shape) == (2, 3) and t.dtype == jnp.float32
+    # the callers' probe pattern: vma is a set on VMA jax, absent before
+    vma = getattr(t, "vma", None)
+    assert vma is None or isinstance(vma, (set, frozenset, tuple))
+
+
+# -------------------------------------------------- cost analysis shapes
+
+
+class _CostList:
+    """jax <= 0.4.x: per-module list; entry module first."""
+
+    def cost_analysis(self):
+        return [{"flops": 12.0, "bytes accessed": 3.0}, {"flops": 99.0}]
+
+
+class _CostDict:
+    def cost_analysis(self):
+        return {"flops": 7.5}
+
+
+class _CostEmptyList:
+    def cost_analysis(self):
+        return []
+
+
+class _CostNone:
+    def cost_analysis(self):
+        return None
+
+
+class _CostRaises:
+    def cost_analysis(self):
+        raise NotImplementedError("no cost model on this backend")
+
+
+def test_cost_analysis_normalizes_every_api_shape():
+    assert compiled_cost_analysis(_CostList()) == {
+        "flops": 12.0, "bytes accessed": 3.0,
+    }
+    assert compiled_cost_analysis(_CostDict()) == {"flops": 7.5}
+    assert compiled_cost_analysis(_CostEmptyList()) is None
+    assert compiled_cost_analysis(_CostNone()) is None
+    assert compiled_cost_analysis(_CostRaises()) is None
+
+
+def test_cost_analysis_returns_a_fresh_dict():
+    """Mutating the normalized dict must not corrupt a cached analysis."""
+    src = _CostDict()
+    d = compiled_cost_analysis(src)
+    d["flops"] = -1
+    assert compiled_cost_analysis(src) == {"flops": 7.5}
+
+
+# ------------------------------------------------- memory analysis shapes
+
+
+class _MemOld:
+    """CompiledMemoryStats as 0.4.x ships it: no peak field."""
+
+    argument_size_in_bytes = 1000
+    output_size_in_bytes = 300
+    temp_size_in_bytes = 700
+    alias_size_in_bytes = 100
+    generated_code_size_in_bytes = 50
+
+
+class _MemNew(_MemOld):
+    peak_memory_in_bytes = 4242
+
+
+def _compiled_with(stats):
+    class C:
+        def memory_analysis(self):
+            return stats
+
+    return C()
+
+
+def test_memory_stats_assembles_peak_on_legacy_fields():
+    out = compiled_memory_stats(_compiled_with(_MemOld()))
+    assert out["peak_hbm_bytes"] == 1000 + 300 + 700 + 50 - 100
+    assert out["alias_size_in_bytes"] == 100
+
+
+def test_memory_stats_prefers_backend_peak():
+    out = compiled_memory_stats(_compiled_with(_MemNew()))
+    assert out["peak_hbm_bytes"] == 4242
+
+
+def test_memory_stats_dict_shaped_future_api():
+    out = compiled_memory_stats(_compiled_with({
+        "argument_size_in_bytes": 10,
+        "temp_size_in_bytes": 5,
+        "not_a_known_field": 77,
+        "generated_code_size_in_bytes": "not-a-number",
+    }))
+    assert out == {
+        "argument_size_in_bytes": 10,
+        "temp_size_in_bytes": 5,
+        "peak_hbm_bytes": 15,
+    }
+
+
+def test_memory_stats_degrades_to_none():
+    class NoApi:
+        pass
+
+    class Raises:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    assert compiled_memory_stats(NoApi()) is None
+    assert compiled_memory_stats(_compiled_with(None)) is None
+    assert compiled_memory_stats(Raises()) is None
+    # an object with none of the known fields: no stats, not zeros
+    class Alien:
+        irrelevant = 1
+
+    assert compiled_memory_stats(_compiled_with(Alien())) is None
+
+
+# --------------------------------------------- end-to-end on this jax
+
+
+def test_both_probes_work_on_a_real_compiled_program():
+    compiled = (
+        jax.jit(lambda a: (a @ a).sum()).lower(jnp.ones((64, 64))).compile()
+    )
+    cost = compiled_cost_analysis(compiled)
+    assert cost and cost.get("flops", 0) >= 2 * 64**3
+    mem = compiled_memory_stats(compiled)
+    if mem is not None:  # some backends expose no memory stats at all
+        assert mem["peak_hbm_bytes"] > 0

@@ -20,7 +20,7 @@ def test_heart_loader_schema(heart):
     # slices cover the matrix disjointly
     spans = sorted(heart["feature_slices"].values())
     assert spans[0][0] == 0 and spans[-1][1] == heart["x"].shape[1]
-    for (a, b), (c, d) in zip(spans, spans[1:]):
+    for (_, b), (c, _) in zip(spans, spans[1:]):
         assert b == c
 
 

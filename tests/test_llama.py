@@ -51,7 +51,7 @@ def test_stage_split_roundtrip_and_equivalence():
     y = x
     for si in range(2):
         y = llama.apply_blocks(
-            jax.tree.map(lambda p: p[si], staged["blocks"]), y, CFG
+            jax.tree.map(lambda p, si=si: p[si], staged["blocks"]), y, CFG
         )
     np.testing.assert_allclose(full, y, atol=1e-5, rtol=1e-5)
 

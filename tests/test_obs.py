@@ -34,9 +34,8 @@ def _obs_off():
 
 def test_span_json_validates_against_chrome_trace_schema(tmp_path):
     rec = obs.SpanRecorder(process_name="test-proc")
-    with rec.span("outer", cat="host", k=1):
-        with rec.span("inner"):
-            time.sleep(0.002)
+    with rec.span("outer", cat="host", k=1), rec.span("inner"):
+        time.sleep(0.002)
     rec.instant("marker", note="x")
 
     out = rec.to_chrome_trace()
@@ -65,7 +64,8 @@ def test_span_json_validates_against_chrome_trace_schema(tmp_path):
     assert any(e["args"].get("name") == "test-proc" for e in meta)
 
     p = rec.save(str(tmp_path / "trace.json"))
-    assert json.load(open(p))["traceEvents"]
+    with open(p) as f:
+        assert json.load(f)["traceEvents"]
 
 
 def test_spans_threadsafe_and_disabled_is_noop():

@@ -207,16 +207,15 @@ def test_sp_tokenizer_warns_once_on_pure_python_fallback():
     """Without the sentencepiece package the wrapper must SAY it swapped
     in the approximate pure-Python processor (no NFKC, no byte-fallback
     — see data/sp_model.py's divergence notes), not swap silently."""
+    import contextlib
     import warnings
 
     from ddl25spring_tpu.data.tokenizer import SentencePieceTokenizer
 
-    try:
+    with contextlib.suppress(ImportError):
         import sentencepiece  # noqa: F401
 
         pytest.skip("real sentencepiece installed; no fallback to warn on")
-    except ImportError:
-        pass
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         SentencePieceTokenizer("data/tinystories.model")

@@ -241,7 +241,7 @@ def test_zero_moe_llama_composition(devices8):
     shards = zero_shard_params(params, mesh)
     ost = tx.init(shards)
     losses = []
-    for i in range(15):
+    for _ in range(15):
         shards, ost, loss = step(
             shards, ost, tokens, jax.random.PRNGKey(2)
         )
@@ -281,7 +281,7 @@ def test_zero_stage12_equals_plain_dp(stage, devices8):
 
     p_ref, o_ref = params, tx.init(params)
     p_z, o_z = params, tx.init(zero_shard_params(params, mesh))
-    for i in range(3):
+    for _ in range(3):
         p_ref, o_ref, loss_ref = dp(p_ref, o_ref, batch, key)
         p_z, o_z, loss_z = z(p_z, o_z, batch, key)
         np.testing.assert_allclose(float(loss_ref), float(loss_z), rtol=1e-5)

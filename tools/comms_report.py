@@ -16,29 +16,18 @@ a requested strategy fails to compile at all.
 
 from __future__ import annotations
 
-import os
 import sys
 from pathlib import Path
 
-# CPU-only with a multi-device fake host — must be decided before the
-# first jax backend init (this image registers a TPU plugin at
-# interpreter start, hence the config route in main()).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from ddl25spring_tpu.utils.metrics import fmt_bytes as _fmt_bytes  # noqa: E402
+from ddl25spring_tpu.utils.platform import ensure_cpu_tools_env  # noqa: E402
 
-def _fmt_bytes(b: float) -> str:
-    for unit in ("B", "KiB", "MiB", "GiB"):
-        if abs(b) < 1024 or unit == "GiB":
-            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
-        b /= 1024
-    return f"{b:.1f} GiB"
+# CPU-only with a multi-device fake host — must be decided before the
+# first jax backend init (this image registers a TPU plugin at
+# interpreter start, hence also the config route in main()).
+ensure_cpu_tools_env()
 
 
 def format_strategy_report(r: dict) -> str:
@@ -116,7 +105,30 @@ def format_strategy_report(r: dict) -> str:
     elif r.get("expected"):
         lines.append("  signature: OK (matches the declared analytic "
                      "collective signature)")
+    lines.append("  " + _findings_cell(r))
     return "\n".join(lines)
+
+
+def _findings_cell(r: dict) -> str:
+    """The hazard-findings column: count + worst severity, sourced from
+    the static analyzer (``ddl25spring_tpu/analysis``; run per strategy
+    by ``compile_strategy`` and in full by ``tools/graft_lint.py``)."""
+    if r.get("lint_error"):
+        return f"hazards: lint degraded ({r['lint_error']})"
+    if "findings" not in r:
+        return "hazards: not analyzed (lint=False)"
+    from ddl25spring_tpu.analysis.engine import summarize
+
+    s = summarize(r["findings"])
+    if not s["findings"]:
+        return "hazards: none"
+    cell = f"hazards: {s['unwaived']} unwaived"
+    if s["worst"]:
+        cell += f" (worst {s['worst']})"
+    if s["waived"]:
+        cell += f", {s['waived']} waived"
+    rules = ",".join(sorted(s["by_rule"]))
+    return f"{cell} [{rules}] — see python -m tools.graft_lint"
 
 
 def main(argv=None) -> int:
@@ -132,6 +144,7 @@ def main(argv=None) -> int:
     from ddl25spring_tpu.obs.compile_report import (
         DEFAULT_STRATEGIES,
         build_compile_report,
+        parse_mesh_arg,
     )
 
     ap = argparse.ArgumentParser(description=__doc__)
@@ -155,10 +168,7 @@ def main(argv=None) -> int:
         names = list(DEFAULT_STRATEGIES) if args.all else ["dp"]
     else:
         names = [s.strip() for s in args.strategy.split(",") if s.strip()]
-    mesh_sizes = (
-        tuple(int(x) for x in args.mesh.lower().split("x"))
-        if args.mesh else None
-    )
+    mesh_sizes = parse_mesh_arg(args.mesh)
 
     report = build_compile_report(names, mesh_sizes)
     if args.json:

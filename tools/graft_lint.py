@@ -1,0 +1,206 @@
+"""graft-lint: static hazard analysis over the repo and its compiled HLO.
+
+    python -m tools.graft_lint                      # source rules only
+    python -m tools.graft_lint --strategy all       # + HLO rules, every strategy
+    python -m tools.graft_lint --strategy zero3,ep --mesh 2x4
+    python -m tools.graft_lint --strategy all --format json
+    python -m tools.graft_lint --strategy all --check    # the CI gate
+
+Two passes share one findings model and one waiver file
+(``analysis/waivers.toml``):
+
+- **HLO pass** — every requested parallel strategy's train step is
+  compiled on a fake CPU mesh (no accelerator anywhere) and the hazard
+  rule pack H001-H007 runs over its optimized HLO: missed async
+  overlap, inverse-collective resharding, unaccountable/hoistable
+  loop collectives, bf16->f32 upcasts on the wire, donation misses,
+  host round-trips, deadlock-shaped permutes and axis leaks.  See
+  ``ddl25spring_tpu/analysis/rules.py`` for the pack.
+- **source pass** — AST rules S101-S103 over the installable package:
+  env reads in traced-code modules, jit call sites without a donation
+  decision, raw numpy inside traced functions.
+
+``--check`` exits non-zero on any *unwaived* finding (or any strategy
+that fails to compile when strategies were requested) — the
+``graft-lint`` CI job runs ``--strategy all --check`` on every PR, with
+per-strategy clean baselines pinned in ``tests/test_hlo_lint.py``.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO_ROOT))
+
+from ddl25spring_tpu.utils.platform import ensure_cpu_tools_env  # noqa: E402
+
+# CPU-only with a multi-device fake host — decided before the first jax
+# backend init (this image registers a TPU plugin at interpreter start,
+# hence also the config call in main()).
+ensure_cpu_tools_env()
+
+
+def _fmt_finding(f: dict) -> str:
+    where = f.get("strategy") or ""
+    anchor = f.get("op") or ""
+    src = f.get("source") or ""
+    loc = " ".join(x for x in (where, anchor, src) if x)
+    line = f"  {f['rule']} [{f['severity']:<5}] {loc}\n      {f['message']}"
+    if f.get("fix_hint"):
+        line += f"\n      fix: {f['fix_hint']}"
+    if f.get("waived"):
+        line += f"\n      WAIVED: {f['waived_reason']}"
+    return line
+
+
+def _render_table(src_findings, hlo_reports) -> str:
+    from ddl25spring_tpu.analysis.engine import summarize
+
+    blocks = []
+    if src_findings is not None:
+        s = summarize(src_findings)
+        blocks.append(
+            f"source lint: {s['findings']} finding(s), "
+            f"{s['unwaived']} unwaived"
+        )
+        blocks.extend(_fmt_finding(f.to_dict()) for f in src_findings)
+    for name, r in (hlo_reports or {}).items():
+        if "error" in r:
+            blocks.append(f"strategy {name}: FAILED to compile: {r['error']}")
+            continue
+        fs = r.get("findings", [])
+        s = summarize(fs)
+        mesh = ", ".join(f"{k}={v}" for k, v in r.get("mesh", {}).items())
+        head = (
+            f"strategy {name} mesh({mesh}) lowered={r.get('lowered', '?')}: "
+            f"{s['findings']} finding(s), {s['unwaived']} unwaived"
+        )
+        if r.get("lint_error"):
+            head += f"  [lint degraded: {r['lint_error']}]"
+        blocks.append(head)
+        blocks.extend(_fmt_finding(f) for f in fs)
+    return "\n".join(blocks)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="graft_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--strategy", default=None,
+                    help="comma-separated strategy names, or 'all' for "
+                         "every registered strategy; omit to skip the "
+                         "HLO pass")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh sizes like 2x4, positional onto each "
+                         "strategy's axis names")
+    ap.add_argument("--format", choices=("table", "json"), default="table")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on any unwaived finding or "
+                         "compile failure (the CI gate)")
+    ap.add_argument("--no-src", action="store_true",
+                    help="skip the source (AST) pass")
+    ap.add_argument("--waivers", default=None, metavar="TOML",
+                    help="waiver file (default: analysis/waivers.toml)")
+    ap.add_argument("--root", default=str(_REPO_ROOT),
+                    help="repo root for the source pass")
+    args = ap.parse_args(argv)
+
+    from ddl25spring_tpu.analysis import engine, source_lint
+    from ddl25spring_tpu.analysis.waivers import apply_waivers, load_waivers
+
+    waivers = load_waivers(args.waivers)
+
+    src_findings = None
+    if not args.no_src:
+        src_findings = apply_waivers(
+            source_lint.lint_repo(args.root), waivers
+        )
+
+    hlo_reports: dict = {}
+    if args.strategy:
+        import jax
+
+        # env alone is too late on images whose sitecustomize registers
+        # a TPU plugin at interpreter start; force CPU regardless
+        jax.config.update("jax_platforms", "cpu")
+
+        from ddl25spring_tpu.obs.compile_report import (
+            DEFAULT_STRATEGIES,
+            parse_mesh_arg,
+        )
+
+        names = (
+            list(DEFAULT_STRATEGIES)
+            if args.strategy.strip().lower() == "all"
+            else [s.strip() for s in args.strategy.split(",") if s.strip()]
+        )
+        mesh_sizes = parse_mesh_arg(args.mesh)
+        for name in names:
+            r = engine.lint_strategy(name, mesh_sizes)
+            if args.waivers and "findings" in r:
+                # a custom waiver file overrides the default one the
+                # strategy report already resolved against: re-apply
+                fresh = [
+                    engine.Finding(
+                        **{**f, "waived": False, "waived_reason": None}
+                    )
+                    for f in r["findings"]
+                ]
+                r["findings"] = [
+                    f.to_dict() for f in apply_waivers(fresh, waivers)
+                ]
+            hlo_reports[name] = r
+
+    if args.format == "json":
+        doc = {
+            "record": "graft_lint",
+            "source": [f.to_dict() for f in src_findings or []],
+            "strategies": hlo_reports,
+        }
+        print(json.dumps(doc, indent=1, default=str))
+    else:
+        print(_render_table(src_findings, hlo_reports))
+
+    if args.check:
+        bad = 0
+        for f in src_findings or []:
+            if not f.waived:
+                print(f"CHECK FAIL source: {f.rule} {f.source} {f.op}",
+                      file=sys.stderr)
+                bad += 1
+        for name, r in hlo_reports.items():
+            if "error" in r:
+                print(f"CHECK FAIL {name}: did not compile: {r['error']}",
+                      file=sys.stderr)
+                bad += 1
+                continue
+            if r.get("lint_error"):
+                print(f"CHECK FAIL {name}: lint degraded: "
+                      f"{r['lint_error']}", file=sys.stderr)
+                bad += 1
+            for f in r.get("findings", []):
+                if not f.get("waived"):
+                    print(f"CHECK FAIL {name}: {f['rule']} {f.get('op')}: "
+                          f"{f['message']}", file=sys.stderr)
+                    bad += 1
+        if bad:
+            print(f"\ngraft-lint: {bad} unwaived finding(s)/failure(s)",
+                  file=sys.stderr)
+            return 1
+        src_msg = (
+            "source pass clean" if src_findings is not None
+            else "source pass SKIPPED (--no-src)"
+        )
+        print(f"graft-lint OK: {src_msg}, {len(hlo_reports)} strategy "
+              "HLO pass(es) clean (waivers applied)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
