@@ -69,9 +69,63 @@ import threading
 import jax
 
 
-def probe_devices(timeout_s: float):
+# failure reason codes for per-attempt telemetry (satellite: classify
+# retry failures instead of shipping a raw error string)
+REASON_DEVICE = "device_unreachable"
+REASON_COMPILE = "compile_error"
+REASON_RUNTIME = "runtime_error"
+REASON_STALLED = "stalled"
+
+_DEVICE_MARKERS = (
+    "accelerator unreachable", "device init timed out", "unavailable",
+    "deadline_exceeded", "failed to connect", "connection", "tunnel",
+    "no devices", "backend 'tpu' failed to initialize",
+)
+_COMPILE_MARKERS = (
+    "compil", "lowering", "mosaic", "hlo", "xla_internal",
+    "unimplemented",
+)
+
+
+def classify_failure(error: str | None) -> str:
+    """Map an attempt's error string to a coarse reason code, so a
+    BENCH_r*.json capture states *what kind* of death occurred without
+    anyone grepping raw strings: ``device_unreachable`` (tunnel/backend
+    init), ``stalled`` (watchdog/driver timeout killed a wedged run),
+    ``compile_error`` (lowering/XLA compilation), ``runtime_error``
+    (everything else)."""
+    e = (error or "").lower()
+    if "exceeded" in e and "killed" in e:
+        return REASON_STALLED
+    if any(m in e for m in _DEVICE_MARKERS):
+        return REASON_DEVICE
+    if any(m in e for m in _COMPILE_MARKERS):
+        return REASON_COMPILE
+    return REASON_RUNTIME
+
+
+def probe_devices(timeout_s: float, flight_dir: str | None = None):
     """jax.devices() with a timeout: backend init dials the TPU tunnel and
-    can block forever when the relay is down — a daemon thread bounds it."""
+    can block forever when the relay is down — a daemon thread bounds it,
+    and a stall watchdog wraps the wait so the r01–r05 failure mode
+    (bare ``device init timed out``) now produces a stack-attributed
+    ``flight.json`` naming the frame the probe thread is wedged in.
+
+    Returns ``(devices, error, flight_dump_path)``.
+
+    Coverage note: the watchdog (like any Python thread) can only run
+    while the probe's native call releases the GIL — true for the
+    socket-blocked dead-tunnel case this targets, NOT for init paths
+    that spin in native code holding the GIL (observed once with the
+    TPU plugin's metadata retry loop, which freezes every thread in the
+    process).  That mode is unkillable from inside; the parent driver's
+    subprocess timeout reaps it and the retry record classifies it
+    ``stalled``.
+    """
+    import time
+
+    from ddl25spring_tpu.obs import StallWatchdog, flight
+
     out: dict = {}
 
     def _probe():
@@ -80,12 +134,33 @@ def probe_devices(timeout_s: float):
         except Exception as e:  # noqa: BLE001 — report, don't hang
             out["error"] = f"{type(e).__name__}: {e}"
 
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "devices" in out:
-        return out["devices"], None
-    return None, out.get("error", f"device init timed out after {timeout_s:.0f}s")
+    flight.annotate(probe_timeout_s=timeout_s)
+    t = threading.Thread(target=_probe, daemon=True, name="device-init-probe")
+    # the watchdog deadline sits PAST the join timeout: an init that
+    # succeeds just under the wire must never race the monitor into
+    # recording a stall (which would fail --check-health on a healthy
+    # run); on a real wedge the join times out first and the wait loop
+    # below spans the margin
+    margin = 2.0
+    wd = StallWatchdog(
+        deadline_s=timeout_s + margin, run_dir=flight_dir,
+        name="device-init-probe", source="self",
+    )
+    with wd:
+        t.start()
+        t.join(timeout_s)
+        if "devices" in out:
+            return out["devices"], None, None
+        if "error" not in out:
+            # wedged, not raised: wait out the margin + a poll so the
+            # watchdog takes its thread-stack dump
+            deadline = time.perf_counter() + margin + 2 * wd.poll_s + 5.0
+            while not wd.fired and time.perf_counter() < deadline:
+                time.sleep(0.05)
+    err = out.get(
+        "error", f"device init timed out after {timeout_s:.0f}s"
+    )
+    return None, err, wd.dump_path
 
 
 def attach_parent_telemetry(
@@ -105,6 +180,20 @@ def attach_parent_telemetry(
     if compile_report is not None:
         tel["compile_report"] = compile_report
         tel["lint"] = lint_summary(compile_report)
+    # runtime-health summary: when the record (or any attempt) carries a
+    # flight dump, surface it at telemetry.health so a dead run's BENCH
+    # line points straight at its post-mortem artifact
+    health = tel.get("health") if isinstance(tel.get("health"), dict) else {}
+    dump = record.get("flight_dump") or next(
+        (f.get("flight_dump") for f in reversed(failures or [])
+         if f.get("flight_dump")), None,
+    )
+    if dump and "flight_dump" not in health:
+        health["flight_dump"] = dump
+    if "error" in record:
+        health.setdefault("reason", classify_failure(record["error"]))
+    if health:
+        tel["health"] = health
     record["telemetry"] = tel
     return record
 
@@ -160,8 +249,11 @@ def run_with_retries(
     in-process retry can never recover from a transient tunnel outage.
 
     Every failed attempt emits one structured JSONL record to stderr
-    (``{"record": "bench_retry_failure", attempt, error, backoff_s,
-    wall_s, rc}``) and the accumulated records ride the FINAL printed
+    (``{"record": "bench_retry_failure", attempt, error, reason,
+    backoff_s, wall_s, rc}`` — ``reason`` is the coarse
+    :func:`classify_failure` code, and ``flight_dump`` rides along when
+    the child took a post-mortem dump) and the accumulated records ride
+    the FINAL printed
     line's ``telemetry.retry_failures`` — so a BENCH_r*.json capture of a
     flaky/dead tunnel carries its own diagnosis instead of a bare 0.0
     (the r01–r05 failure mode).  ``compile_report`` (computed by the
@@ -231,9 +323,15 @@ def run_with_retries(
             "attempt": i + 1,
             "attempts_left": attempts - i - 1,
             "error": str(last.get("error", "unknown")),
+            "reason": classify_failure(str(last.get("error", "unknown"))),
             "rc": rc,
             "wall_s": round(time.perf_counter() - t0, 3),
             "backoff_s": next_backoff,
+            **(
+                {"flight_dump": last["flight_dump"]}
+                if isinstance(last, dict) and last.get("flight_dump")
+                else {}
+            ),
         }
         failures.append(rec)
         print(json.dumps(rec), file=sys.stderr)
@@ -378,16 +476,38 @@ def main(argv=None) -> None:
         force_cpu_devices(args.force_cpu_devices)
     elif args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    devices, err = probe_devices(args.probe_timeout)
+
+    # arm the crash paths before any device contact: from here on an
+    # unhandled exception, SIGTERM, or exit leaves a flight.json behind
+    from ddl25spring_tpu.obs import flight
+
+    flight.configure(run_dir=args.obs_dir)
+    flight.install()
+    flight.annotate(
+        driver="bench",
+        argv=list(argv if argv is not None else sys.argv[1:]),
+    )
+
+    devices, err, probe_dump = probe_devices(
+        args.probe_timeout, flight_dir=args.obs_dir
+    )
     if devices is None:
         record = {
             "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
             "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
             "error": f"accelerator unreachable: {err}",
+            **({"flight_dump": probe_dump} if probe_dump else {}),
         }
-        if compile_report is not None:
-            attach_parent_telemetry(record, None, compile_report)
-        print(json.dumps(record))
+        attach_parent_telemetry(record, None, compile_report)
+        print(json.dumps(record), flush=True)
+        sys.stderr.flush()
+        # a wedged backend init leaves jax's atexit machinery deadlocked
+        # on the half-initialized backend (observed on this image: the
+        # TPU plugin's metadata retry loop), which would strand this
+        # JSON line in a block buffer forever — the r01–r05 silent-child
+        # mode.  Everything worth persisting is flushed; exit hard.
+        if "timed out" in str(err):
+            os._exit(0)
         return
 
     import time
@@ -439,6 +559,11 @@ def main(argv=None) -> None:
                 devices, dp, S, M, batch
             )
     n_chips = meta["n_chips"]
+    flight.annotate(
+        layout=meta["layout"], topology=meta["topology"],
+        n_chips=n_chips, batch=batch, scan_steps=K,
+        rng_seed=ds.seed,  # the DeviceDataset epoch-shuffle key
+    )
 
     if args.obs_dir:
         lg = obs.MetricsLogger(
@@ -597,6 +722,25 @@ def main(argv=None) -> None:
                 for name, ph in s.get("phases", {}).items()
             },
         }
+
+    # runtime-health cell: sentinel state + flight-recorder facts, and a
+    # flight.json in the run dir so obs_report's Health section (and any
+    # post-mortem) reads the same artifact a crash would have left
+    from ddl25spring_tpu.obs import sentinels as _sentinels
+
+    _snap = obs.flight.snapshot()
+    health = {
+        "sentinels": _sentinels.enabled(),
+        "policy": _sentinels.policy(),
+        # cumulative counter, not a ring recount: a violation hundreds
+        # of steps back must still show after the ring evicted it
+        "violations": _snap["violations"],
+        "stalls": _snap["stalls"],
+        "flight_records": _snap["recorded"],
+    }
+    if args.obs_dir:
+        health["flight_dump"] = obs.flight.dump(reason="end_of_run")
+    telemetry["health"] = health
 
     primary_mode = (
         f"{ds.input_mode}-scan{K}" if multi is not None else ds.input_mode

@@ -43,6 +43,7 @@ def build_resnet_step(
     dtype: Any = None,
     instrument: bool | None = None,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Build the north-star train step on ``devices[: dp * S]``.
 
@@ -63,6 +64,12 @@ def build_resnet_step(
     ResNet replica + momentum buffers live once in HBM instead of twice
     across the update — callers must rebind ``params, opt_state`` from
     the step's outputs every call (``timed_run`` and both drivers do).
+
+    ``sentinel`` threads through to the inner DP / pipeline builder:
+    in-step numerics sentinels (loss, grad global-norm, non-finite leaf
+    flags, update ratio) with policy log/halt/skip on violation
+    (:mod:`ddl25spring_tpu.obs.sentinels`; None = follow
+    ``DDL25_SENTINELS`` at build time; HLO-identical when disabled).
     """
     if S not in (1, 2, 3, 4):
         raise ValueError(f"resnet pipeline supports S in (1, 2, 3, 4), got {S}")
@@ -98,7 +105,7 @@ def build_resnet_step(
             lambda logits, b: cross_entropy_logits(logits, b["y"]),
             (mb, 32, 32, 3), [(mb,) + s[1:] for s in shapes],
             tx, mesh, M, data_axis="data" if dp > 1 else None,
-            compute_dtype=dtype, instrument=instrument,
+            compute_dtype=dtype, instrument=instrument, sentinel=sentinel,
         )
 
         @partial(jax.jit, donate_argnums=donate_argnums(donate))
@@ -119,7 +126,8 @@ def build_resnet_step(
             return cross_entropy_logits(logits, yb)
 
         inner = make_dp_train_step(
-            loss_fn, tx, mesh, per_shard_rng=False, instrument=instrument
+            loss_fn, tx, mesh, per_shard_rng=False, instrument=instrument,
+            sentinel=sentinel,
         )
         key = jax.random.PRNGKey(1)
 
@@ -157,6 +165,7 @@ def build_resnet_scan_step(
     dtype: Any = None,
     instrument: bool | None = None,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """K train steps per dispatch: the on-device input+train loop.
 
@@ -188,7 +197,7 @@ def build_resnet_scan_step(
 
     step1, params, opt_state, meta = build_resnet_step(
         devices, dp, S, num_microbatches, batch, lr, dtype,
-        instrument=instrument, donate=donate,
+        instrument=instrument, donate=donate, sentinel=sentinel,
     )
     K = scan_steps
 
@@ -255,7 +264,8 @@ class DeviceDataset:
             return xs[idx], ys[idx]
 
         self._select = select
-        self._key = jax.random.PRNGKey(20)
+        self.seed = 20  # epoch-shuffle key; surfaced in run metadata
+        self._key = jax.random.PRNGKey(self.seed)
         # block on the one-time upload so it's not billed to the timed loop
         self.x.block_until_ready()
         self.y.block_until_ready()
@@ -412,6 +422,13 @@ def timed_run(
     per-record walls, so logging I/O never inflates the headline.
     ``steps_per_call`` scales the per-record sample count for scan-fused
     dispatches (K train steps per call).
+
+    Every dispatch also feeds the flight recorder
+    (:data:`ddl25spring_tpu.obs.flight` — a host-side ring-buffer append,
+    never part of the compiled program): the logger path records one
+    step entry per call (the crash-surviving post-mortem trail), the
+    bare path beats liveness so a stall watchdog watching the run sees
+    progress either way.
     """
     from ddl25spring_tpu import obs
 
@@ -419,12 +436,14 @@ def timed_run(
     with obs.span("warmup", label=label, n=warmup):
         for _ in range(warmup):
             params, opt_state, loss = step(params, opt_state, feed())
+            obs.flight.beat()
         if loss is not None:
             float(loss)
     if logger is None:
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, loss = step(params, opt_state, feed())
+            obs.flight.beat()
         float(loss)  # the step chain is data-dependent through params
         return time.perf_counter() - t0, params, opt_state
 
@@ -437,6 +456,10 @@ def timed_run(
                 lval = float(loss)  # force completion per call
             wall = time.perf_counter() - prev
             total += wall
+            obs.flight.record(
+                kind="step", strategy=label, step=i,
+                wall_s=round(wall, 6), loss=lval,
+            )
             logger.log(
                 step=i,
                 label=label,

@@ -19,6 +19,20 @@ being usable:
 - ``tools/obs_report.py`` — folds a run directory into a summary table
   (steps/sec p50/p95, MFU, bubble fraction, h2d bandwidth).
 
+Runtime health (the operable half — the compile-time analytics'
+runtime counterpart):
+
+- :mod:`~ddl25spring_tpu.obs.sentinels` — in-step numerics sentinels
+  (loss / grad global-norm / non-finite leaves / update ratio computed
+  INSIDE the compiled step; policy log/halt/skip on violation; gated by
+  ``DDL25_SENTINELS`` with the same HLO-identical-when-disabled pin);
+- :mod:`~ddl25spring_tpu.obs.recorder` — crash-surviving flight
+  recorder (ring buffer of the last N step records, dumped as
+  ``flight.json`` on unhandled exception / SIGTERM / atexit);
+- :mod:`~ddl25spring_tpu.obs.watchdog` — stall watchdog (fires when no
+  step completes within a deadline; dumps all host thread stacks plus
+  the flight record).
+
 Everything is gated by one trace-time flag (:mod:`~ddl25spring_tpu.obs.
 state`): disabled (the default), instrumented step functions lower to HLO
 identical to uninstrumented ones — zero cost, pinned in
@@ -26,11 +40,15 @@ identical to uninstrumented ones — zero cost, pinned in
 *before* building/tracing the step.
 """
 
+from ddl25spring_tpu.obs import sentinels
 from ddl25spring_tpu.obs.counters import (
     CounterSet,
     counters,
     gpipe_bubble_fraction,
 )
+from ddl25spring_tpu.obs.recorder import FlightRecorder, flight
+from ddl25spring_tpu.obs.sentinels import SentinelViolation
+from ddl25spring_tpu.obs.watchdog import StallWatchdog, thread_stacks
 from ddl25spring_tpu.obs.logger import (
     MetricsLogger,
     iter_jsonl,
@@ -52,9 +70,15 @@ from ddl25spring_tpu.obs.state import enable, enabled, scoped
 
 __all__ = [
     "CounterSet",
+    "FlightRecorder",
     "MetricsLogger",
+    "SentinelViolation",
     "SpanRecorder",
+    "StallWatchdog",
     "counters",
+    "flight",
+    "sentinels",
+    "thread_stacks",
     "enable",
     "enabled",
     "get_recorder",

@@ -1,0 +1,318 @@
+"""Crash-surviving flight recorder: the last N step records, dumpable.
+
+BENCH rounds r01–r05 all died with ``accelerator unreachable: device
+init timed out`` and left **zero post-mortem state** — the JSON error
+line was the entire forensic record.  The flight recorder closes that
+gap: a thread-safe ring buffer of the last ``N`` step records (step
+index, sentinel values, wall/dispatch timings, strategy, plus whatever
+run metadata — mesh, layout, RNG seed — the driver annotates), persisted
+as a structured ``flight.json`` on
+
+- **unhandled exception** (a chained ``sys.excepthook``),
+- **SIGTERM** (the scheduler-kill path; the previous handler is chained),
+- **interpreter exit** (``atexit``, skipped when a dump already covers
+  the latest records),
+- **explicit calls** — the sentinel ``halt`` policy and the stall
+  watchdog both dump through here,
+
+so a dead run is diagnosable from artifacts alone.  Recording is pure
+host-side bookkeeping (a deque append under a lock) — nothing here ever
+touches a traced program, so it is always on wherever a driver calls
+it; the handlers install only on request (:meth:`FlightRecorder.
+install`), never at import.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import math
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ddl25spring_tpu.utils.config import env_float
+
+DEFAULT_CAPACITY = 256
+FLIGHT_BASENAME = "flight.json"
+_UNSET = object()  # configure() sentinel: "leave as is" vs "clear"
+
+
+def default_flight_dir() -> str:
+    """Where dumps land when no run dir was configured: the
+    ``DDL25_FLIGHT_DIR`` env (through the sanctioned boundary's module —
+    a plain read here since this is host-only code) or ``runs/flight``."""
+    return os.environ.get("DDL25_FLIGHT_DIR") or os.path.join(
+        "runs", "flight"
+    )
+
+
+def _json_safe(v: Any):
+    """NaN/Inf are exactly what flight records carry on the day they
+    matter — encode them as strings so the dump stays strict JSON.
+    Foreign scalar types (numpy float32 losses, jax ints in annotate())
+    coerce through ``float``/``str``: a crash dump must never fail on
+    the shape of what it is recording."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else repr(v)  # 'nan', 'inf', '-inf'
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    try:  # numpy/jax scalars and anything float-like
+        return _json_safe(float(v))
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class FlightRecorder:
+    """Thread-safe ring buffer of run-health records + dump machinery."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._records: deque[dict] = deque(maxlen=capacity)
+        self._meta: dict[str, Any] = {}
+        self._seq = 0
+        # cumulative, ring-eviction-proof: a violation recorded 1000
+        # steps ago must still fail --check-health even after the ring
+        # rolled past it
+        self._counts = {"violation": 0, "stall": 0}
+        self._last: dict[str, dict] = {}
+        self._run_dir: str | None = None
+        self._t0 = time.perf_counter()
+        self._last_beat = time.perf_counter()
+        self._dumped_seq = -1
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_sigterm = None
+
+    # ---- recording ------------------------------------------------------
+
+    def configure(self, run_dir=_UNSET, capacity: int | None = None) -> None:
+        """Set the dump directory and/or ring capacity.  ``run_dir=None``
+        CLEARS a previously-set directory (back to the
+        :func:`default_flight_dir` fallback) — the distinction from
+        "not passed" matters for anything resetting the shared
+        recorder, or a stale test/run dir leaks into later dumps."""
+        with self._lock:
+            if run_dir is not _UNSET:
+                self._run_dir = run_dir
+            if capacity is not None and capacity != self._records.maxlen:
+                self._records = deque(self._records, maxlen=capacity)
+
+    def annotate(self, **meta: Any) -> None:
+        """Attach run-level facts (strategy, mesh, layout, RNG seed…)
+        that every dump should carry; last write per key wins."""
+        with self._lock:
+            self._meta.update(meta)
+
+    def record(
+        self, kind: str = "step", *, touch: bool = True, **fields: Any
+    ) -> dict:
+        """Append one record to the ring; returns it (with ``seq`` and
+        wall-clock offsets assigned).  Cheap: one locked deque append.
+        ``touch=False`` records WITHOUT counting as liveness — the
+        stall watchdog uses it so its own stall record doesn't read as
+        the progress that would re-arm it mid-stall."""
+        now = time.perf_counter()
+        with self._lock:
+            rec = {
+                "seq": self._seq,
+                "kind": kind,
+                "t_s": round(now - self._t0, 6),
+                **fields,
+            }
+            self._seq += 1
+            self._records.append(rec)
+            if kind in self._counts:
+                self._counts[kind] += 1
+                self._last[kind] = rec
+            if touch:
+                self._last_beat = now
+        return rec
+
+    def beat(self) -> None:
+        """Liveness tick without a record — the watchdog's heartbeat."""
+        with self._lock:
+            self._last_beat = time.perf_counter()
+
+    def seconds_since_beat(self) -> float:
+        with self._lock:
+            return time.perf_counter() - self._last_beat
+
+    def last(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            recs = list(self._records)
+        return recs if n is None else recs[-n:]
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "meta": dict(self._meta),
+                "capacity": self._records.maxlen,
+                "recorded": self._seq,
+                "violations": self._counts["violation"],
+                "stalls": self._counts["stall"],
+                **{
+                    f"last_{k}": dict(r) for k, r in self._last.items()
+                },
+                "records": [dict(r) for r in self._records],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._meta.clear()
+            self._seq = 0
+            self._counts = {"violation": 0, "stall": 0}
+            self._last.clear()
+            self._dumped_seq = -1
+            self._t0 = time.perf_counter()
+            self._last_beat = time.perf_counter()
+
+    # ---- dumping --------------------------------------------------------
+
+    def dump(
+        self,
+        path: str | None = None,
+        reason: str = "manual",
+        extra: dict | None = None,
+    ) -> str:
+        """Write ``flight.json`` (atomically: temp file + rename, so a
+        crash mid-dump never leaves a truncated artifact where a good
+        one could have been) and return its path."""
+        if path is None:
+            d = self._run_dir or default_flight_dir()
+            path = os.path.join(d, FLIGHT_BASENAME)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        doc = self.snapshot()
+        doc["record"] = "flight"
+        doc["reason"] = reason
+        doc["dumped_at_unix"] = time.time()
+        # violations/stalls ride the CUMULATIVE counters (snapshot), not
+        # a recount of the bounded ring — a violation recorded hundreds
+        # of steps before an end_of_run/atexit dump must still fail the
+        # --check-health gate after the ring evicted it, and a later
+        # dump must not erase an earlier watchdog fire.  The watchdog's
+        # own dump overrides `stall` with its richer point-in-time info
+        # (thread stacks) via ``extra``.
+        last_stall = doc.pop("last_stall", None)
+        if last_stall is not None:
+            doc["stall"] = {
+                k: v for k, v in last_stall.items()
+                if k not in ("seq", "kind")
+            }
+        if extra:
+            doc.update(extra)
+        # pid AND thread id: the watchdog's monitor thread and the main
+        # thread's excepthook/halt can dump concurrently — two writers
+        # sharing one temp name would interleave and break atomicity
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(doc), f, indent=1, allow_nan=False)
+        os.replace(tmp, path)
+        with self._lock:
+            # mark only the SNAPSHOTTED records as dumped: a record
+            # appended on another thread mid-write is not in this
+            # artifact, and the atexit pending-check must still see it
+            self._dumped_seq = max(self._dumped_seq, doc["recorded"])
+        return path
+
+    # ---- crash handlers -------------------------------------------------
+
+    def install(self, run_dir: str | None = None) -> None:
+        """Arm the crash paths: excepthook + SIGTERM + atexit, each
+        chaining to whatever was installed before.  Idempotent."""
+        if run_dir is not None:
+            self.configure(run_dir=run_dir)
+        if self._installed:
+            return
+        self._installed = True
+
+        self._prev_excepthook = sys.excepthook
+
+        def _hook(exc_type, exc, tb):
+            # whatever dump() hits, the original exception must still
+            # reach the user
+            with contextlib.suppress(Exception):
+                self.dump(
+                    reason="unhandled_exception",
+                    extra={"exception": f"{exc_type.__name__}: {exc}"},
+                )
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = _hook
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _on_term(signum, frame):
+                # a failed dump must not break signal handling
+                with contextlib.suppress(Exception):
+                    self.dump(reason="sigterm")
+                if prev is signal.SIG_IGN:
+                    return  # the process chose to ignore TERM: dump only
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    # exit NOW with the conventional 128+SIGTERM status
+                    # (re-delivering through the default handler would
+                    # require surviving another interpreter round-trip,
+                    # and a dying process owes the world nothing more
+                    # than its flight dump).  Caveat shared by any
+                    # Python-level handler: a main thread wedged in
+                    # native code that holds the GIL never runs this —
+                    # the stall watchdog and the driver's hard kill
+                    # cover that mode.
+                    sys.stderr.flush()
+                    os._exit(128 + signum)
+
+            signal.signal(signal.SIGTERM, _on_term)
+            self._prev_sigterm = prev
+        except (ValueError, OSError):
+            # not the main thread (or an exotic platform): the excepthook
+            # and atexit paths still cover crashes
+            self._prev_sigterm = None
+
+        atexit.register(self._atexit_dump)
+
+    def _atexit_dump(self) -> None:
+        with self._lock:
+            pending = self._seq > self._dumped_seq and self._seq > 0
+        if pending:
+            with contextlib.suppress(Exception):  # exit must stay clean
+                self.dump(reason="atexit")
+
+    def uninstall(self) -> None:
+        """Disarm the handlers (test harness); atexit's entry becomes a
+        no-op via the dumped-seq check rather than unregistration."""
+        if not self._installed:
+            return
+        self._installed = False
+        sys.excepthook = self._prev_excepthook or sys.__excepthook__
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except (ValueError, OSError):
+                pass
+        with contextlib.suppress(Exception):  # best-effort disarm
+            atexit.unregister(self._atexit_dump)
+        with self._lock:
+            self._dumped_seq = self._seq
+
+
+flight = FlightRecorder()
+
+
+def watchdog_deadline_default() -> float:
+    """The stall watchdog's default deadline (seconds):
+    ``DDL25_WATCHDOG_S`` or 900 s — long enough for a cold compile, far
+    shorter than a wedged tunnel's forever."""
+    return env_float("DDL25_WATCHDOG_S", 900.0)

@@ -152,6 +152,33 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
             for n in sorted({e["name"] for e in evs})
         }
 
+    # runtime health, when a flight recorder dumped into this run dir
+    # (ddl25spring_tpu/obs/recorder.py): sentinel violations, the last
+    # step records, and — for stall dumps — the host thread stacks
+    from ddl25spring_tpu.obs.recorder import FLIGHT_BASENAME
+
+    fpath = os.path.join(run_dir, FLIGHT_BASENAME)
+    if os.path.exists(fpath):
+        try:
+            with open(fpath) as f:
+                fl = json.load(f)
+            out["health"] = {
+                "reason": fl.get("reason"),
+                "recorded": fl.get("recorded"),
+                "violations": fl.get("violations", 0),
+                "last_violation": fl.get("last_violation"),
+                "stall": fl.get("stall"),
+                "thread_stacks": sorted(fl.get("thread_stacks", {})),
+                "meta": fl.get("meta", {}),
+                "last_records": (fl.get("records") or [])[-5:],
+                "exception": fl.get("exception"),
+            }
+        except (json.JSONDecodeError, OSError) as e:
+            # a truncated dump must not cost the measured metrics
+            out["health"] = {
+                "error": f"unreadable {FLIGHT_BASENAME}: {e}"
+            }
+
     # compile-time analytics, when a bench/CLI run dropped its report here
     # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
     # compiled collectives/HBM/MFU-projection below, one run dir
@@ -260,6 +287,46 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("host spans (trace.json — load in Perfetto):")
         for n, cnt in summary["span_counts"].items():
             lines.append(f"  {n:<40} x{cnt}")
+
+    h = summary.get("health")
+    if h:
+        lines.append("")
+        lines.append("health (flight.json — the crash-surviving ring):")
+        if h.get("error"):
+            lines.append(f"  {h['error']}")
+        else:
+            lines.append(
+                f"  dump reason: {h.get('reason')}  records: "
+                f"{h.get('recorded')}  sentinel violations: "
+                f"{h.get('violations', 0)}"
+            )
+            lv = h.get("last_violation")
+            if lv:
+                lines.append(
+                    f"  last violation: strategy={lv.get('strategy')} "
+                    f"step={lv.get('step')} "
+                    f"metric={lv.get('violating_metric')} "
+                    f"leaves={lv.get('nonfinite_leaves', [])}"
+                )
+            st = h.get("stall")
+            if st:
+                lines.append(
+                    f"  STALL: watchdog={st.get('watchdog')} idle "
+                    f"{st.get('idle_s')}s past deadline "
+                    f"{st.get('deadline_s')}s — "
+                    f"{len(h.get('thread_stacks', []))} host thread "
+                    "stacks in the dump"
+                )
+            if h.get("exception"):
+                lines.append(f"  died on: {h['exception']}")
+            for r in h.get("last_records", []):
+                bits = "  ".join(
+                    f"{k}={r[k]}"
+                    for k in ("strategy", "step", "loss", "grad_norm",
+                              "wall_s", "violating_metric")
+                    if k in r
+                )
+                lines.append(f"  [{r.get('kind', 'step')}] {bits}")
 
     cr = summary.get("compile_report")
     if cr:

@@ -1,0 +1,379 @@
+"""In-step numerics sentinels: on-device health checks for train steps.
+
+A diverging run today fails silently (NaN loss propagates until the
+checkpoint is garbage) or late (the human notices the loss curve).  The
+sentinel wrapper computes the health facts *inside* the compiled step —
+loss, global gradient norm, per-leaf non-finite flags, update-to-param
+ratio — and surfaces them through the same ``jax.debug.callback`` host
+path the :mod:`~ddl25spring_tpu.obs.counters` already use, feeding the
+:mod:`~ddl25spring_tpu.obs.recorder` flight ring buffer so the last N
+steps are always reconstructible from artifacts.
+
+Gating follows the PR-1 contract exactly: every insertion decision is
+made at TRACE time from one module flag (``DDL25_SENTINELS=1`` /
+:func:`enable` / :func:`scoped`, read through the sanctioned
+``utils.config.env_flag`` boundary), so with sentinels disabled an
+instrumented step builder lowers to HLO **byte-identical** to an
+uninstrumented one (pinned per strategy in ``tests/test_health.py``).
+Enabled, the cost is one fused host transfer of a handful of scalars
+per step (per device shard when the guard sits inside ``shard_map``).
+
+Violation policy (``DDL25_SENTINEL_POLICY`` = ``log`` | ``halt`` |
+``skip``, or per-builder override):
+
+- ``log``: record the violation in the flight ring + counters and warn.
+- ``halt``: raise :class:`SentinelViolation` from the host callback,
+  carrying the offending step's flight-record context — strategy, step
+  index, which metric tripped, which gradient leaves went non-finite —
+  and the path of the ``flight.json`` dumped before raising.  Halt is
+  TERMINAL: a callback that raises leaves the backend's dispatch
+  stream errored (observed on the CPU runtime: every later dispatch in
+  the process inherits the failure), which is exactly right for a run
+  dying loudly but means halt is not a catch-and-continue mechanism —
+  recoverable behavior is what ``skip`` is for.
+- ``skip``: additionally *suppress the update on device*: the step
+  returns its (params, opt_state) inputs unchanged for the poisoned
+  step (a ``jnp.where`` select on the all-finite predicate), so one bad
+  batch costs one step instead of the run.  (The select keeps the
+  pre-step buffers live past the update, so XLA may decline the
+  builders' input-output donation for that build — the expected price
+  of a guarded update path.)
+
+**Async-dispatch caveat (halt policy):** JAX dispatches steps
+asynchronously, so the host callback that raises runs while the *next*
+step may already be enqueued.  The exception therefore surfaces at the
+next blocking point (``block_until_ready``, the next host transfer, or
+``jax.effects_barrier()``) — up to one step after the poisoned one
+executed on device, and possibly wrapped in the runtime's
+``XlaRuntimeError``.  The flight record is written *before* the raise
+and always names the exact offending step; trust the dump, not the
+traceback's timing.  ``skip`` has no such lag: the select happens on
+device, in the poisoned step itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import threading
+
+from ddl25spring_tpu.utils.config import env_choice, env_flag
+
+log = logging.getLogger(__name__)
+
+POLICIES = ("log", "halt", "skip")
+
+_enabled: bool = env_flag("DDL25_SENTINELS")
+_policy: str = env_choice("DDL25_SENTINEL_POLICY", POLICIES, "log")
+_lock = threading.Lock()
+_steps: dict[str, int] = {}  # host-side per-strategy step counter
+_last_violation: dict | None = None
+
+
+class SentinelViolation(FloatingPointError):
+    """A numerics sentinel tripped under the ``halt`` policy.
+
+    Subclasses ``FloatingPointError`` so generic float-error handling
+    still catches it, but the message (and ``.context``) carry the
+    flight-record context a bare FloatingPointError loses: strategy,
+    step index, the violating metric, the non-finite gradient leaves,
+    and the flight-dump path.
+    """
+
+    def __init__(self, message: str, context: dict | None = None):
+        super().__init__(message)
+        self.context = dict(context or {})
+
+
+def enabled() -> bool:
+    """Are sentinels on?  Checked at TRACE time by :func:`guard`."""
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Flip the sentinel flag (affects subsequent traces only, exactly
+    like :func:`ddl25spring_tpu.obs.state.enable`)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def policy() -> str:
+    return _policy
+
+
+def set_policy(mode: str) -> None:
+    global _policy
+    if mode not in POLICIES:
+        raise ValueError(f"policy {mode!r} is not one of {POLICIES}")
+    _policy = mode
+
+
+@contextlib.contextmanager
+def scoped(on: bool = True, policy: str | None = None):
+    """Temporarily set the sentinel flag (and optionally the policy) —
+    the test-harness entry, mirroring ``obs.scoped``."""
+    global _enabled, _policy
+    prev, prev_pol = _enabled, _policy
+    _enabled = bool(on)
+    if policy is not None:
+        set_policy(policy)
+    try:
+        yield
+    finally:
+        _enabled, _policy = prev, prev_pol
+
+
+def resolve(
+    enabled: bool | None = None, policy: str | None = None
+) -> tuple[bool, str]:
+    """BUILD-time resolution of the sentinel gate + policy, mirroring
+    the ``instr = obs.enabled() if instrument is None else ...``
+    convention of PR 1.  Builders call this when the step is *built* and
+    bake the result into the traced closure — tracing happens lazily (at
+    ``.lower()`` or first call), possibly long after a ``scoped()``
+    block or an ``enable()`` toggle has been unwound, so reading module
+    state at trace time would silently follow the wrong flag."""
+    on = _enabled if enabled is None else bool(enabled)
+    mode = _policy if policy is None else policy
+    if mode not in POLICIES:
+        raise ValueError(f"policy {mode!r} is not one of {POLICIES}")
+    return on, mode
+
+
+def last_violation() -> dict | None:
+    """The most recent violation record (host side), or None."""
+    with _lock:
+        return dict(_last_violation) if _last_violation else None
+
+
+def reset() -> None:
+    """Clear host-side step counters + last violation (test harness)."""
+    global _last_violation
+    with _lock:
+        _steps.clear()
+        _last_violation = None
+
+
+# --------------------------------------------------------------- the guard
+
+
+def guard(
+    strategy: str,
+    results,
+    *,
+    loss=None,
+    grads=None,
+    params=None,
+    updates=None,
+    fallback=None,
+    axis=None,
+    enabled: bool | None = None,
+    policy: str | None = None,
+):
+    """The generic sentinel wrapper every train-step builder opts into.
+
+    Call INSIDE the jitted step, after the update — with the gate and
+    policy resolved at BUILD time (see :func:`resolve`; passing the raw
+    tri-state kwarg here would read the module flag lazily at trace
+    time, after any ``scoped()`` block has unwound)::
+
+        s_on, s_policy = sentinels.resolve(sentinel)  # at build time
+        ...
+        new_params = optax.apply_updates(params, updates)
+        new_params, opt_state = sentinels.guard(
+            "dp", (new_params, opt_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state_in),
+            enabled=s_on, policy=s_policy)
+
+    ``results`` is the pytree the step is about to return (minus the
+    loss, which policies never rewrite); ``fallback`` is the matching
+    pre-update pytree the ``skip`` policy selects when the step is
+    poisoned.  ``axis``: when the guard sits inside ``shard_map``, the
+    mesh axis to reduce over so norms/flags are global (the callback
+    then fires per shard; the host side keeps shard 0's record).
+
+    ``enabled`` is the per-builder tri-state (None = follow the module
+    flag at trace time; True/False hard-enable/-disable), ``policy``
+    the per-builder override of the module policy.  Disabled, this
+    returns ``results`` **unchanged** — the same object, nothing enters
+    the HLO (the zero-cost contract, pinned in ``tests/test_health.py``).
+    """
+    on = _enabled if enabled is None else bool(enabled)
+    if not on:
+        return results
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mode = _policy if policy is None else policy
+    if mode not in POLICIES:
+        raise ValueError(f"policy {mode!r} is not one of {POLICIES}")
+
+    # per-leaf flags cover grads AND updates: an optimizer whose state
+    # went non-finite poisons the update while the grads are still
+    # clean (e.g. NaN Adam moments) — checking grads alone would detect
+    # it one step late, after skip's fallback is already poisoned
+    leaves, leaf_names = [], []
+    for prefix, tree in (("grads", grads), ("updates", updates)):
+        if tree is None:
+            continue
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        leaf_names += [prefix + jax.tree_util.keystr(p) for p, _ in flat]
+        leaves += [l for _, l in flat]
+
+    def _sumsq(tree):
+        if tree is None:
+            return None
+        return sum(
+            jnp.sum(jnp.square(l.astype(jnp.float32)))
+            for l in jax.tree.leaves(tree)
+        )
+
+    gnorm2 = _sumsq(grads)
+    unorm2 = _sumsq(updates)
+    pnorm2 = _sumsq(params)
+    if leaves:
+        flags = jnp.stack(
+            [jnp.any(~jnp.isfinite(l)).astype(jnp.float32) for l in leaves]
+        )
+    else:
+        flags = jnp.zeros((0,), jnp.float32)
+    loss_val = (
+        jnp.asarray(loss, jnp.float32)
+        if loss is not None else jnp.float32(0.0)
+    )
+    shard_idx = jnp.int32(0)
+    if axis is not None:
+        # inside shard_map: make every reduced fact global before it
+        # crosses to the host (each shard holds distinct rows of the
+        # grad layout, so psum of square-norms IS the global norm²)
+        gnorm2 = lax.psum(gnorm2, axis) if gnorm2 is not None else None
+        unorm2 = lax.psum(unorm2, axis) if unorm2 is not None else None
+        pnorm2 = lax.psum(pnorm2, axis) if pnorm2 is not None else None
+        if leaves:
+            flags = lax.pmax(flags, axis)
+        shard_idx = lax.axis_index(axis)
+
+    neg1 = jnp.float32(-1.0)  # "not measured" marker (host side reads <0)
+    gnorm2_c = gnorm2 if gnorm2 is not None else neg1
+    unorm2_c = unorm2 if unorm2 is not None else neg1
+    pnorm2_c = pnorm2 if pnorm2 is not None else neg1
+
+    ok = jnp.isfinite(loss_val)
+    if gnorm2 is not None:
+        ok = ok & jnp.isfinite(gnorm2)
+    if unorm2 is not None:
+        ok = ok & jnp.isfinite(unorm2)
+    if leaves:
+        ok = ok & (jnp.sum(flags) == 0)
+
+    # static context rides a partial, NOT callback kwargs (the callback
+    # protocol treats kwargs as traced pytrees; strings aren't jax types)
+    from functools import partial as _partial
+
+    jax.debug.callback(
+        _partial(
+            _on_step,
+            strategy=strategy, leaf_names=tuple(leaf_names), mode=mode,
+            has_loss=loss is not None,
+        ),
+        loss_val, gnorm2_c, flags, unorm2_c, pnorm2_c, ok, shard_idx,
+    )
+
+    if mode == "skip" and fallback is not None:
+        results = jax.tree.map(
+            lambda new, old: jnp.where(ok, new, old), results, fallback
+        )
+    return results
+
+
+def _on_step(
+    loss, gnorm2, flags, unorm2, pnorm2, ok, shard_idx,
+    *, strategy, leaf_names, mode, has_loss,
+):
+    """Host side of the sentinel callback: fold the step's facts into
+    the flight ring + counters; enforce the policy on violation."""
+    from ddl25spring_tpu.obs.counters import counters as _counters
+    from ddl25spring_tpu.obs.recorder import flight
+
+    if int(shard_idx) != 0:
+        # shard_map replays the callback once per shard with identical
+        # (already globally reduced) values: keep shard 0's record, but
+        # let every arrival count as liveness for the stall watchdog
+        flight.beat()
+        return
+
+    global _last_violation
+    loss = float(loss)
+    gnorm = math.sqrt(g2) if (g2 := float(gnorm2)) >= 0 else None
+    u2, p2 = float(unorm2), float(pnorm2)
+    ratio = (
+        math.sqrt(u2) / (math.sqrt(p2) + 1e-20)
+        if u2 >= 0 and p2 >= 0 else None
+    )
+    bad_leaves = [n for n, f in zip(leaf_names, flags) if float(f) > 0]
+    violation = not bool(ok)
+
+    with _lock:
+        step = _steps.get(strategy, 0)
+        _steps[strategy] = step + 1
+
+    if has_loss:
+        _counters.add(f"{strategy}.sentinel.loss", loss)
+    if gnorm is not None and math.isfinite(gnorm):
+        _counters.add(f"{strategy}.sentinel.grad_norm", gnorm)
+    if ratio is not None and math.isfinite(ratio):
+        _counters.add(f"{strategy}.sentinel.update_ratio", ratio)
+
+    rec = {
+        "strategy": strategy,
+        "step": step,
+        "policy": mode,
+        "violation": violation,
+        **({"loss": loss} if has_loss else {}),
+        **({"grad_norm": gnorm} if gnorm is not None else {}),
+        **({"update_ratio": ratio} if ratio is not None else {}),
+        **({"nonfinite_leaves": bad_leaves} if bad_leaves else {}),
+    }
+    if not violation:
+        flight.record(kind="step", **rec)
+        return
+
+    # name the single most specific metric that tripped — the halt
+    # message and the dump must identify it without post-processing
+    # (leaf names arrive prefixed "grads..."/"updates...")
+    if bad_leaves:
+        metric = bad_leaves[0]
+    elif has_loss and not math.isfinite(loss):
+        metric = "loss"
+    else:
+        metric = "grad_norm"
+    rec["violating_metric"] = metric
+    flight.record(kind="violation", **rec)
+    _counters.add("sentinel.violations", 1.0)
+    with _lock:
+        _last_violation = dict(rec)
+
+    msg = (
+        f"sentinel violation in strategy={strategy!r} step={step}: "
+        f"{metric} went non-finite"
+        + (f" (loss={loss})" if has_loss else "")
+        + (f"; non-finite leaves: {bad_leaves}" if bad_leaves else "")
+    )
+    if mode == "halt":
+        path = None
+        try:
+            path = flight.dump(reason="sentinel_halt")
+        except Exception as e:  # noqa: BLE001 — the dump must not
+            # mask the violation itself
+            log.warning("flight dump failed during halt: %s", e)
+        raise SentinelViolation(
+            msg + (f"; flight record dumped to {path}" if path else ""),
+            context=dict(rec, flight_dump=path),
+        )
+    if mode == "skip":
+        log.warning("%s; policy=skip — update suppressed on device", msg)
+    else:
+        log.warning("%s; policy=log — continuing", msg)

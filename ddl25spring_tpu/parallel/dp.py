@@ -46,17 +46,31 @@ def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Single-device jitted trainstep (parity: the centralized loop of
     ``lab/tutorial_1b/primer/intro.py:23-33``).  Serves as the serial side of
-    the DP-equivalence oracle (SURVEY §4)."""
+    the DP-equivalence oracle (SURVEY §4).
+
+    ``sentinel`` (None = follow the global ``DDL25_SENTINELS`` flag at
+    build time): in-step numerics sentinels via
+    :func:`ddl25spring_tpu.obs.sentinels.guard` — zero-cost and
+    HLO-identical when disabled, like every builder here."""
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
 
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch, key):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "serial", (new_params, new_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 
@@ -70,6 +84,7 @@ def make_dp_train_step(
     instrument: bool | None = None,
     bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Gradient-aggregation DP trainstep over ``mesh[axis]``.
 
@@ -100,10 +115,19 @@ def make_dp_train_step(
     the step's peak HBM drops by ~the params+opt bytes (pinned donated <
     undonated in ``tests/test_bucketing.py``).  Callers re-using the
     input trees after the call must pass ``donate=False``.
+
+    ``sentinel`` (None = follow ``DDL25_SENTINELS`` at build time):
+    in-step numerics sentinels — loss / grad global-norm / non-finite
+    leaf flags / update-to-param ratio computed inside the compiled
+    step, policy log/halt/skip on violation
+    (:mod:`ddl25spring_tpu.obs.sentinels`).  Disabled, the HLO is
+    byte-identical to an unguarded build (``tests/test_health.py``).
     """
     from ddl25spring_tpu import obs
+    from ddl25spring_tpu.obs import sentinels
 
     instr = obs.enabled() if instrument is None else bool(instrument)
+    s_on, s_policy = sentinels.resolve(sentinel)
 
     @partial(
         shard_map,
@@ -154,9 +178,14 @@ def make_dp_train_step(
                 for g in jax.tree.leaves(grads)
             )
             obs.counters.emit("dp.grad_norm", jnp.sqrt(gnorm_sq), force=True)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "dp", (new_params, new_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 
@@ -168,6 +197,7 @@ def make_dp_weight_avg_step(
     axis: str = "data",
     per_shard_rng: bool = True,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Weight-aggregation DP: local step, then average weights over ``axis``.
 
@@ -175,7 +205,15 @@ def make_dp_weight_avg_step(
     leading ``[n_replicas, ...]`` dim sharded over ``axis`` (build it with
     :func:`stack_opt_state`).  Params enter and leave replicated (averaged
     every step, i.e. sync_every=1, the reference scripts' cadence).
+
+    ``sentinel``: in-step numerics sentinels
+    (:mod:`ddl25spring_tpu.obs.sentinels`; cross-shard facts reduced
+    over ``axis`` — the grad norm aggregates every replica's local
+    gradient).
     """
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     n = mesh.shape[axis]
 
     @partial(
@@ -192,11 +230,18 @@ def make_dp_weight_avg_step(
         # implicit cross-shard psum) — each replica steps on its own data,
         # as each reference rank does before the weight sync.
         local_params = pcast(params, axis, to="varying")
+        opt0 = opt_state
         loss, grads = jax.value_and_grad(loss_fn)(local_params, batch, key)
         updates, opt_state = tx.update(grads, opt_state, local_params)
         stepped = optax.apply_updates(local_params, updates)
         # the *intended* all_reduce-of-weights of intro_DP_WA.py:54-67
         avg_params = lax.pmean(stepped, axis)
+        avg_params, opt_state = sentinels.guard(
+            "dp-weight-avg", (avg_params, opt_state),
+            loss=lax.pmean(loss, axis), grads=grads, params=local_params,
+            updates=updates, fallback=(params, opt0), axis=axis,
+            enabled=s_on, policy=s_policy,
+        )
         return (
             avg_params,
             jax.tree.map(lambda x: x[None], opt_state),

@@ -308,6 +308,7 @@ def make_ep_train_step(
     axis: str = "expert",
     capacity_factor: float = 1.25,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Jitted train step for the standalone EP MoE layer: regression to a
     target output plus the load-balancing aux loss — the train-step
@@ -321,9 +322,15 @@ def make_ep_train_step(
     psums over the expert axis automatically (the router is an
     axis-invariant input under shard_map autodiff), so the compiled step
     adds one small all-reduce to the layer's all-to-all signature.
+
+    ``sentinel`` opts into the in-step numerics sentinels
+    (:mod:`ddl25spring_tpu.obs.sentinels`).
     """
     import optax
 
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     moe = make_ep_moe_fn(mesh, axis, capacity_factor=capacity_factor)
 
     def loss_fn(p, batch):
@@ -334,9 +341,14 @@ def make_ep_train_step(
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "ep", (new_params, new_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 

@@ -200,10 +200,16 @@ def make_het_pipeline_train_step(
     mesh: Mesh,
     num_microbatches: int,
     donate: bool | None = None,
+    sentinel: bool | None = None,
     **kw,
 ):
     """Jitted DPxPP train step over heterogeneous stages (the benchmark
-    topology: 2-stage ResNet pipeline x DP with microbatches)."""
+    topology: 2-stage ResNet pipeline x DP with microbatches).
+    ``sentinel`` opts into the in-step numerics sentinels
+    (:mod:`ddl25spring_tpu.obs.sentinels`)."""
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     pipe_loss = make_het_pipeline_loss(
         stage_fns, loss_fn, in_shape, boundary_shapes, mesh,
         num_microbatches, **kw,
@@ -212,9 +218,14 @@ def make_het_pipeline_train_step(
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(pipe_loss)(params, batch)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "het_pipeline", (new_params, new_state), loss=loss,
+            grads=grads, params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 
@@ -459,15 +470,20 @@ def make_sharded_het_pipeline_train_step(
     num_microbatches: int,
     stage_axis: str = "stage",
     donate: bool | None = None,
+    sentinel: bool | None = None,
     **kw,
 ):
     """Stage-sharded DPxPP train step: params AND optimizer state live
     sharded ``[S, maxP]`` over the stage axis (optax transforms are
     elementwise on the flat buffer, so sharding propagates through the
     update).  Returns ``(step, stacked_params, opt_state)`` with both
-    pytrees placed on the mesh."""
+    pytrees placed on the mesh.  ``sentinel`` opts into the in-step
+    numerics sentinels (:mod:`ddl25spring_tpu.obs.sentinels`)."""
     from jax.sharding import NamedSharding
 
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     stacked, metas = pack_stage_params(stage_params)
     stacked = jax.device_put(stacked, NamedSharding(mesh, P(stage_axis)))
     pipe_loss = make_sharded_het_pipeline_loss(
@@ -478,8 +494,13 @@ def make_sharded_het_pipeline_train_step(
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(stacked, opt_state, batch):
         loss, grads = jax.value_and_grad(pipe_loss)(stacked, batch)
-        updates, opt_state = tx.update(grads, opt_state, stacked)
-        stacked = optax.apply_updates(stacked, updates)
-        return stacked, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, stacked)
+        new_stacked = optax.apply_updates(stacked, updates)
+        new_stacked, new_state = sentinels.guard(
+            "het_pipeline-sharded", (new_stacked, new_state), loss=loss,
+            grads=grads, params=stacked, updates=updates,
+            fallback=(stacked, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_stacked, new_state, loss
 
     return step, stacked, opt_state

@@ -1269,6 +1269,7 @@ def make_pipeline_train_step(
     seq_axis: str | None = None,
     sp_mode: str = "ring",
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Jitted train step for the (DPx)PP llama workload: the one-program
     replacement for the reference's 3- or 6-process schedule + per-group
@@ -1306,8 +1307,13 @@ def make_pipeline_train_step(
     ring/ulysses attention.
 
     ``donate`` (default on): params/opt-state buffers alias in place
-    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`).
+    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`); ``sentinel``
+    opts into the in-step numerics sentinels
+    (:mod:`ddl25spring_tpu.obs.sentinels`).
     """
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     if seq_axis is not None and schedule not in (
         "gpipe", "1f1b", "interleaved-1f1b"
     ):
@@ -1358,9 +1364,14 @@ def make_pipeline_train_step(
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, tokens):
         loss, grads = vag(params, tokens)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "pipeline", (new_params, new_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 

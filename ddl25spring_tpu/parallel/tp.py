@@ -265,20 +265,31 @@ def make_tp_train_step(
     data_axis: str | None = None,
     shard_vocab: bool = True,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Jitted TP(xDP) train step; params stay sharded across steps.
     Switch-MoE configs shard their expert stacks over the model axis
     (:func:`make_tp_moe_fn`) and train with the aux loss folded in.
     ``donate`` (default on): params/opt-state buffers alias in place
-    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`)."""
+    (:func:`~ddl25spring_tpu.parallel.dp.donate_argnums`); ``sentinel``
+    opts into the in-step numerics sentinels
+    (:mod:`ddl25spring_tpu.obs.sentinels`)."""
+    from ddl25spring_tpu.obs import sentinels
+
+    s_on, s_policy = sentinels.resolve(sentinel)
     loss_fn = make_tp_loss(cfg, mesh, model_axis, data_axis, shard_vocab)
 
     @partial(jax.jit, donate_argnums=donate_argnums(donate))
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_params, new_state = sentinels.guard(
+            "tp", (new_params, new_state), loss=loss, grads=grads,
+            params=params, updates=updates,
+            fallback=(params, opt_state), enabled=s_on, policy=s_policy,
+        )
+        return new_params, new_state, loss
 
     return step
 

@@ -165,6 +165,7 @@ def make_zero_dp_train_step(
     instrument: bool | None = None,
     bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """Build the fully-sharded trainstep.
 
@@ -210,8 +211,18 @@ def make_zero_dp_train_step(
     ``donate`` (default on, :func:`~ddl25spring_tpu.parallel.dp.
     donate_argnums`): alias the param-shard and opt-state inputs to the
     outputs — the sharded update runs in place.
+
+    ``sentinel`` (None = follow ``DDL25_SENTINELS`` at build time):
+    in-step numerics sentinels over the SHARDED gradient tree — the
+    square-norm and non-finite flags psum/pmax over ``axis`` before
+    crossing to the host, so the facts are global even though each
+    device only ever holds its ``[1, k]`` rows
+    (:mod:`ddl25spring_tpu.obs.sentinels`).
     """
     from ddl25spring_tpu import obs
+    from ddl25spring_tpu.obs import sentinels as _sentinels
+
+    s_on, s_policy = _sentinels.resolve(sentinel)
 
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
@@ -320,9 +331,15 @@ def make_zero_dp_train_step(
             gshards = jax.tree.map(lambda g: g / n, gshards)
             if instr:
                 obs.counters.emit("zero.loss", lax.pmean(loss, axis), force=True)
-            updates, ostate = tx.update(gshards, ostate, pshards)
-            pshards = optax.apply_updates(pshards, updates)
-            return pshards, ostate, lax.pmean(loss, axis)
+            updates, new_state = tx.update(gshards, ostate, pshards)
+            new_shards = optax.apply_updates(pshards, updates)
+            new_shards, new_state = _sentinels.guard(
+                "zero3", (new_shards, new_state),
+                loss=lax.pmean(loss, axis), grads=gshards, params=pshards,
+                updates=updates, fallback=(pshards, ostate), axis=axis,
+                enabled=s_on, policy=s_policy,
+            )
+            return new_shards, new_state, lax.pmean(loss, axis)
 
         return sharded_step(param_shards, opt_state, batch, key)
 
@@ -375,6 +392,7 @@ def make_zero_partitioned_train_step(
     per_shard_rng: bool = True,
     bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """ZeRO stage-1/2 trainstep: REPLICATED params, SHARDED optimizer
     state (and, at stage 2, sharded reduced gradients).
@@ -410,8 +428,12 @@ def make_zero_partitioned_train_step(
     flat buckets — the stage-1 all-reduce, the stage-2 reduce-scatter,
     and the updated-rows all-gather each launch once per BUCKET instead
     of once per leaf; ``donate`` (default on) aliases params/opt-state in
-    place.
+    place; ``sentinel`` opts into the in-step numerics sentinels over
+    the sharded grad rows (:mod:`ddl25spring_tpu.obs.sentinels`).
     """
+    from ddl25spring_tpu.obs import sentinels as _sentinels
+
+    s_on, s_policy = _sentinels.resolve(sentinel)
     if stage not in (1, 2):
         raise ValueError(f"stage must be 1 or 2, got {stage} "
                          "(stage 3 is make_zero_dp_train_step)")
@@ -481,13 +503,19 @@ def make_zero_partitioned_train_step(
                 lambda p: lax.dynamic_slice_in_dim(p, i, 1, 0),
                 pack_tree(params),
             )
-            updates, ostate = tx.update(gshard, ostate, pshard)
+            updates, new_state = tx.update(gshard, ostate, pshard)
             new_shard = optax.apply_updates(pshard, updates)
+            new_shard, new_state = _sentinels.guard(
+                f"zero{stage}", (new_shard, new_state),
+                loss=lax.pmean(loss, axis), grads=gshard, params=pshard,
+                updates=updates, fallback=(pshard, ostate), axis=axis,
+                enabled=s_on, policy=s_policy,
+            )
             if plan is not None:
                 # hand the updated rows back bucket-packed so the
                 # P(axis) -> P() resharding below gathers per bucket
                 new_shard = tuple(_pack_rows(plan, new_shard))
-            return new_shard, ostate, lax.pmean(loss, axis)
+            return new_shard, new_state, lax.pmean(loss, axis)
 
         new_shards, opt_state, loss = sharded_step(
             params, opt_state, batch, key
@@ -568,6 +596,7 @@ def make_zero3_llama_train_step(
     prefetch: bool = True,
     per_shard_rng: bool = True,
     donate: bool | None = None,
+    sentinel: bool | None = None,
 ):
     """ZeRO-3 over the scanned LLaMA layer stack with GATHER PREFETCH:
     the all-gather for layer ``i+1``'s parameters is issued *before*
@@ -606,7 +635,10 @@ def make_zero3_llama_train_step(
     chain (asserted in ``tests/test_bucketing.py``).
     """
     from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.obs import sentinels as _sentinels
     from ddl25spring_tpu.ops.losses import causal_lm_loss
+
+    s_on, s_policy = _sentinels.resolve(sentinel)
 
     n = mesh.shape[axis]
     L = cfg.n_layers
@@ -733,9 +765,15 @@ def make_zero3_llama_train_step(
             loss, gshards = jax.value_and_grad(shard_loss)(pshards)
             # gather transposes deliver cross-device SUMS; /n -> DP mean
             gshards = jax.tree.map(lambda g: g / n, gshards)
-            updates, ostate = tx.update(gshards, ostate, pshards)
-            pshards = optax.apply_updates(pshards, updates)
-            return pshards, ostate, lax.pmean(loss, axis)
+            updates, new_state = tx.update(gshards, ostate, pshards)
+            new_shards = optax.apply_updates(pshards, updates)
+            new_shards, new_state = _sentinels.guard(
+                "zero3-prefetch" if prefetch else "zero3-llama",
+                (new_shards, new_state), loss=lax.pmean(loss, axis),
+                grads=gshards, params=pshards, updates=updates,
+                fallback=(pshards, ostate), axis=axis, enabled=s_on, policy=s_policy,
+            )
+            return new_shards, new_state, lax.pmean(loss, axis)
 
         return sharded_step(param_shards, opt_state, tokens, key)
 

@@ -98,3 +98,31 @@ def env_flag(name: str, default: bool = False) -> bool:
     if raw is None:
         return default
     return raw not in ("", "0", "false")
+
+
+def env_choice(name: str, choices: tuple[str, ...], default: str) -> str:
+    """Read an enumerated ``DDL25_*`` setting (same sanctioned boundary
+    as :func:`env_flag`).  Unset/empty -> ``default``; a value outside
+    ``choices`` raises immediately — a typo'd policy silently falling
+    back to the default is exactly how a guard rail fails unnoticed."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    if raw not in choices:
+        raise ValueError(
+            f"{name}={raw!r} is not one of {sorted(choices)}"
+        )
+    return raw
+
+
+def env_float(name: str, default: float) -> float:
+    """Read a float ``DDL25_*`` setting through the sanctioned env
+    boundary (see :func:`env_flag`).  Unset/empty -> ``default``."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    return float(raw)

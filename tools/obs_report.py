@@ -29,6 +29,10 @@ def main(argv=None) -> int:
                                     "counters.json / trace.json)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw summary dict as JSON")
+    ap.add_argument("--check-health", action="store_true",
+                    help="exit non-zero when the run's flight.json "
+                         "records sentinel violations or a stall (the "
+                         "CI health gate)")
     args = ap.parse_args(argv)
 
     try:
@@ -40,6 +44,25 @@ def main(argv=None) -> int:
         print(json.dumps(summary, indent=1, default=str))
     else:
         print(format_report(summary))
+    if args.check_health:
+        h = summary.get("health") or {}
+        problems = []
+        if h.get("violations"):
+            problems.append(f"{h['violations']} sentinel violation(s)")
+        if h.get("stall"):
+            problems.append(
+                f"stall (watchdog {h['stall'].get('watchdog')})"
+            )
+        if h.get("error"):
+            problems.append(h["error"])
+        if problems:
+            print(
+                f"health check FAILED for {args.run_dir}: "
+                + "; ".join(problems),
+                file=sys.stderr,
+            )
+            return 3
+        print(f"health check ok for {args.run_dir}", file=sys.stderr)
     return 0
 
 
