@@ -282,6 +282,20 @@ class DeviceDataset:
         )
         return out
 
+    @property
+    def cursor(self) -> int:
+        """The input-pipeline position (which batch/window of the epoch
+        permutation comes next).  Part of the FULL resume state the
+        fault-tolerance layer checkpoints (:mod:`ddl25spring_tpu.ft.
+        autosave`): together with :attr:`seed` it pins the exact batch
+        sequence, so a resumed run consumes the batches the dead run
+        never got to, not a replay of its epoch from zero."""
+        return self._i
+
+    @cursor.setter
+    def cursor(self, value: int) -> None:
+        self._i = int(value)
+
     def scan_window(self, K: int):
         """Host-side scalars for one ``build_resnet_scan_step`` dispatch:
         ``(key, epoch, off0)`` covering K consecutive disjoint batches of
@@ -406,6 +420,8 @@ def timed_run(
     label: str = "run",
     samples_per_step: int | None = None,
     steps_per_call: int = 1,
+    on_step=None,
+    step_offset: int = 0,
 ):
     """Warmup (compile) then time ``steps`` calls; returns ``(dt, params,
     opt_state)``.  Forces completion via a host transfer — on this image's
@@ -429,6 +445,17 @@ def timed_run(
     step entry per call (the crash-surviving post-mortem trail), the
     bare path beats liveness so a stall watchdog watching the run sees
     progress either way.
+
+    ``on_step(global_i, params, opt_state, loss)`` is the
+    fault-tolerance hook (:mod:`ddl25spring_tpu.ft`): called after each
+    timed dispatch completes, OUTSIDE the timed window (the clock
+    re-arms after it, like the logging I/O), with ``global_i =
+    step_offset + i`` so chaos faults and checkpoint cadence count
+    absolute train-step indices across resumes.  Supplying it forces
+    one loss sync per dispatch (the per-step completion the checkpoint
+    gate needs) — the same cost the logger path already pays.
+    ``step_offset`` also shifts the flight/logger step indices so a
+    resumed run's records continue where the dead run's stopped.
     """
     from ddl25spring_tpu import obs
 
@@ -439,7 +466,7 @@ def timed_run(
             obs.flight.beat()
         if loss is not None:
             float(loss)
-    if logger is None:
+    if logger is None and on_step is None:
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, loss = step(params, opt_state, feed())
@@ -451,26 +478,41 @@ def timed_run(
     with obs.span("timed_run", label=label, steps=steps):
         prev = time.perf_counter()
         for i in range(steps):
-            with obs.span("step", label=label, i=i):
+            gi = step_offset + i
+            with obs.span("step", label=label, i=gi):
                 params, opt_state, loss = step(params, opt_state, feed())
                 lval = float(loss)  # force completion per call
             wall = time.perf_counter() - prev
             total += wall
             obs.flight.record(
-                kind="step", strategy=label, step=i,
+                kind="step", strategy=label, step=gi,
                 wall_s=round(wall, 6), loss=lval,
+                # only the checkpoint-hooked phase's indices share units
+                # with the durable steps — the steps-lost accounting in
+                # bench.py keys on this marker so a secondary phase's
+                # single-step indices never mix with K-fused dispatch
+                # indices
+                **({"resumable": True} if on_step is not None else {}),
             )
-            logger.log(
-                step=i,
-                label=label,
-                wall_s=wall,
-                loss=lval,
-                **(
-                    {"samples": samples_per_step * steps_per_call}
-                    if samples_per_step
-                    else {}
-                ),
-                **({"fused_steps": steps_per_call} if steps_per_call > 1 else {}),
-            )
-            prev = time.perf_counter()  # logging I/O stays outside the window
+            if logger is not None:
+                logger.log(
+                    step=gi,
+                    label=label,
+                    wall_s=wall,
+                    loss=lval,
+                    **(
+                        {"samples": samples_per_step * steps_per_call}
+                        if samples_per_step
+                        else {}
+                    ),
+                    **(
+                        {"fused_steps": steps_per_call}
+                        if steps_per_call > 1 else {}
+                    ),
+                )
+            if on_step is not None:
+                # may save a checkpoint, arm a chaos fault, or raise a
+                # simulated device loss — never inside the timed window
+                on_step(gi, params, opt_state, lval)
+            prev = time.perf_counter()  # I/O stays outside the window
     return total, params, opt_state

@@ -1,0 +1,63 @@
+"""Fault tolerance: survive preemption instead of diagnosing it.
+
+PR 5 made deaths *diagnosable* (sentinels, flight recorder, watchdog);
+this package makes them *survivable* — the recovery counterpart of the
+health stack, built from three cooperating pieces:
+
+- :mod:`~ddl25spring_tpu.ft.chaos` — deterministic fault injection
+  (``DDL25_CHAOS=sigterm@12`` / ``kill@7`` / ``nan_grad@5`` /
+  ``device_loss@9``), the harness that makes every recovery claim
+  falsifiable;
+- :mod:`~ddl25spring_tpu.ft.autosave` — sentinel-gated async
+  checkpointing of the FULL resume state (params, opt state, step,
+  data/rng cursors) with atomic manifests and a crash-path barrier
+  (manifest I/O itself lives in the stdlib-only
+  :mod:`~ddl25spring_tpu.ft.manifest`);
+- :mod:`~ddl25spring_tpu.ft.reshard` — cross-mesh restore: ZeRO shard
+  state saved on ``n`` devices re-lands exactly on a smaller surviving
+  mesh.
+
+``bench.py`` wires all three into its retry driver (``--save-every`` /
+``--resume-from``); :mod:`~ddl25spring_tpu.ft.demo` is the minimal
+deterministic train loop the kill-and-resume equivalence tests drive.
+
+Attribute access is lazy (PEP 562): the retry driver's parent process
+and the post-mortem report poll :mod:`ft.manifest` between relaunches,
+and that read must not drag orbax (via ``autosave``) into processes
+that only ever touch JSON — orbax being broken can be exactly what the
+post-mortem is for.
+"""
+
+_EXPORTS = {
+    "AutoSaver": "autosave",
+    "resume_bundle": "autosave",
+    "ChaosInjector": "chaos",
+    "DeviceLossError": "chaos",
+    "Fault": "chaos",
+    "parse_chaos": "chaos",
+    "MANIFEST_BASENAME": "manifest",
+    "latest_durable_step": "manifest",
+    "read_manifest": "manifest",
+    "write_manifest": "manifest",
+    "reshard_leaf": "reshard",
+    "reshard_state": "reshard",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,344 @@
+"""Resilient checkpointing: sentinel-gated async autosave + auto-resume.
+
+:mod:`ddl25spring_tpu.utils.checkpoint` is the storage primitive (orbax
+wrapper, atomic commit-by-rename).  This module is the operational loop
+around it — the piece that turns "there is a Checkpointer class" into
+"a preempted run loses at most ``save_every`` steps":
+
+- **Full resume state.**  The checkpoint.py docstring has promised
+  data/rng cursors since PR 1; nothing saved them.  :func:`resume_bundle`
+  fixes the contract: params, opt state, the data cursor (which batch of
+  the epoch permutation comes next), and the rng seed travel together,
+  so a resumed run replays the *same* batches a never-killed run would
+  have seen (the kill-and-resume equivalence tests are bitwise for DP
+  because of this).
+
+- **Async, off the step path.**  ``AutoSaver.maybe_save`` enqueues an
+  orbax async save every ``save_every`` steps; serialization overlaps
+  the following steps (orbax snapshots to host before returning, so
+  the saved state is the state *at the save call*).
+
+- **Poisoned-checkpoint prevention.**  A checkpoint of a NaN'd state is
+  worse than no checkpoint — auto-resume would faithfully restore the
+  poison forever.  The gate refuses to persist a step when (a) the
+  step's own loss is non-finite, or (b) the PR-5 numerics sentinels
+  recorded a violation since the last save decision
+  (:func:`obs.sentinels.violation_count`, flushed through
+  ``jax.effects_barrier`` so an async-dispatched callback cannot race
+  the decision).  Skipped saves are flight-recorded
+  (``kind="save_skipped"``) — the gate leaves evidence.
+
+- **Atomic manifest.**  ``manifest.json`` (temp-file + rename, like
+  every dump in this repo) names the last *requested* and last
+  *durable* step, the saved leaf shapes (what cross-mesh restore needs
+  to build its abstract template), and the run facts a post-mortem
+  wants next to them.  Durability bookkeeping rides orbax's own
+  semantics: ``save(k)`` barriers the previous save, so the previous
+  step is durable the moment ``save(k)`` returns — no extra barrier on
+  the step path.
+
+- **Crash-path barrier.**  Construction registers :meth:`AutoSaver.
+  close` on the flight recorder's shutdown chain (excepthook / SIGTERM
+  / atexit), so a preempted run drains its in-flight save instead of
+  truncating it — bounded by ``close_timeout_s`` through
+  ``Checkpointer.wait_until_finished(timeout)`` so a wedged orbax
+  thread cannot outlive the watchdog or the scheduler's kill grace.
+
+- **Auto-resume, cross-mesh included.**  :meth:`AutoSaver.
+  restore_or_init` is the relaunch entry: fresh dir -> ``(init, 0)``;
+  same mesh -> template restore; *different* mesh (the surviving slice
+  is smaller) -> restore through an abstract template built from the
+  manifest's recorded shapes and re-land every shard via
+  :mod:`ddl25spring_tpu.ft.reshard`.  Save and restore events land in
+  the flight ring, and the durable step is annotated into flight meta,
+  so a crash dump answers "what survived" without reading the ckpt dir.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+# manifest I/O lives in ft/manifest.py (pure stdlib — the retry driver
+# and the post-mortem report read it without importing orbax); it is
+# re-exported here because AutoSaver is its writer
+from ddl25spring_tpu.ft.manifest import (  # noqa: F401 — re-export
+    MANIFEST_BASENAME,
+    latest_durable_step,
+    read_manifest,
+    write_manifest,
+)
+from ddl25spring_tpu.obs import sentinels
+from ddl25spring_tpu.obs.recorder import flight
+from ddl25spring_tpu.utils.checkpoint import Checkpointer
+
+log = logging.getLogger(__name__)
+
+
+def resume_bundle(
+    params: Any,
+    opt_state: Any,
+    *,
+    data_cursor: int = 0,
+    rng_seed: int | None = None,
+    **extra: Any,
+) -> dict:
+    """Assemble the FULL resume state the docstring contract promises:
+    model + optimizer + where the input pipeline and rng were.  Scalar
+    cursors ride as int64 arrays so orbax round-trips them exactly."""
+    out = {
+        "params": params,
+        "opt_state": opt_state,
+        "data_cursor": np.asarray(data_cursor, np.int64),
+    }
+    if rng_seed is not None:
+        out["rng_seed"] = np.asarray(rng_seed, np.int64)
+    out.update(extra)
+    return out
+
+
+# --------------------------------------------------------------- AutoSaver
+
+
+class AutoSaver:
+    """Periodic, sentinel-gated, crash-barriered checkpointing.
+
+    ``maybe_save(step, state, loss=...)`` after every completed step;
+    ``restore_or_init(init_state)`` at (re)launch.  ``state`` is any
+    pytree — :func:`resume_bundle` builds the canonical one.  See the
+    module docstring for the full contract.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        save_every: int = 0,
+        *,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+        close_timeout_s: float = 60.0,
+        meta: dict | None = None,
+    ):
+        self._dir = Path(directory).absolute()
+        self.ckpt = Checkpointer(
+            self._dir, max_to_keep=max_to_keep, async_save=async_save
+        )
+        self._async = bool(async_save)
+        self.save_every = int(save_every)
+        self.close_timeout_s = float(close_timeout_s)
+        self._meta = dict(meta or {})
+        self._last_requested: int | None = None
+        self._last_durable: int | None = latest_durable_step(self._dir)
+        self._leaf_shapes: list | None = None
+        # a resumed process that dies before ITS first save still owes
+        # the manifest the previous lineage's facts — most critically
+        # leaf_shapes, which the cross-mesh restore path needs; a close()
+        # that clobbered them to null would break the next resume
+        self._prior_manifest = read_manifest(self._dir) or {}
+        self._seen_violations = sentinels.violation_count()
+        self._closed = False
+        self.saves = 0
+        self.skipped = 0
+        self._hook_name = flight.register_shutdown(
+            self.close, name=f"autosave:{self._dir}"
+        )
+
+    # ---- saving ---------------------------------------------------------
+
+    def _gate(self, loss: float | None) -> str | None:
+        """Why the pending state must NOT be persisted (None = clean).
+        Consumes the sentinel-violation delta either way: one poisoned
+        step blocks one save decision, and under the ``skip`` policy
+        (whose fallback already restored the pre-step state) the next
+        clean interval saves normally again."""
+        if sentinels.enabled():
+            # flush async-dispatched sentinel callbacks: the violation
+            # for the step being judged may still be in flight
+            import jax
+
+            jax.effects_barrier()
+        cur = sentinels.violation_count()
+        fresh = cur - self._seen_violations
+        self._seen_violations = cur
+        if loss is not None and not math.isfinite(loss):
+            return "nonfinite_loss"
+        if fresh > 0:
+            return "sentinel_violation"
+        return None
+
+    def maybe_save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        loss: float | None = None,
+        force: bool = False,
+    ) -> bool:
+        """Save after step ``step`` when the cadence says so and the
+        gate clears; returns True when a save was enqueued."""
+        if self._closed:
+            return False
+        if not force and (
+            self.save_every <= 0 or (step + 1) % self.save_every
+        ):
+            return False
+        reason = self._gate(loss)
+        if reason is not None:
+            self.skipped += 1
+            flight.record(
+                kind="save_skipped", step=step, reason=reason,
+                **({"loss": loss} if loss is not None else {}),
+            )
+            log.warning(
+                "autosave: step %d NOT persisted (%s) — poisoned-"
+                "checkpoint prevention", step, reason,
+            )
+            return False
+        self.save(step, state)
+        return True
+
+    def save(self, step: int, state: Any) -> None:
+        """Unconditional async save + manifest/flight bookkeeping."""
+        import jax
+
+        self.ckpt.save(step, state, force=True)
+        # orbax barriered the PREVIOUS save before starting this one:
+        # that step is durable now (and a synchronous save is durable
+        # the moment it returns)
+        prev, self._last_requested = self._last_requested, step
+        if not self._async:
+            self._mark_durable(step)
+        elif prev is not None:
+            self._mark_durable(prev)
+        self.saves += 1
+        if self._leaf_shapes is None:
+            # dtype via the leaf's own attribute first: np.result_type
+            # chokes on extension dtypes (bfloat16) that np.dtype(name)
+            # resolves fine through ml_dtypes
+            self._leaf_shapes = [
+                [
+                    list(np.shape(leaf)),
+                    str(getattr(leaf, "dtype", None) or np.result_type(leaf)),
+                ]
+                for leaf in jax.tree.leaves(state)
+            ]
+        flight.record(kind="save", step=step)
+        self._write_manifest()
+
+    def _mark_durable(self, step: int) -> None:
+        if self._last_durable is None or step > self._last_durable:
+            self._last_durable = step
+        flight.annotate(
+            ckpt_last_durable_step=self._last_durable,
+            ckpt_dir=str(self._dir),
+        )
+
+    def _write_manifest(self) -> None:
+        # fields this process has no fresh value for fall back to the
+        # prior lineage's manifest; save counters accumulate across the
+        # run lineage so the recovery report counts the whole story
+        prior = self._prior_manifest
+        write_manifest(self._dir, {
+            "record": "ckpt_manifest",
+            "last_requested_step": (
+                self._last_requested
+                if self._last_requested is not None
+                else prior.get("last_requested_step")
+            ),
+            "last_durable_step": self._last_durable,
+            "save_every": self.save_every,
+            "saves": int(prior.get("saves") or 0) + self.saves,
+            "save_skipped": int(prior.get("save_skipped") or 0) + self.skipped,
+            "leaf_shapes": self._leaf_shapes or prior.get("leaf_shapes"),
+            "written_at_unix": time.time(),
+            **({"meta": self._meta} if self._meta else {}),
+        })
+
+    # ---- restoring ------------------------------------------------------
+
+    def restore_or_init(self, init_state: Any) -> tuple[Any, int]:
+        """The relaunch entry: ``(state, next_step)`` from the latest
+        durable checkpoint, or ``(init_state, 0)`` on a fresh start.
+
+        ``init_state`` is the freshly-initialized state a cold run
+        would use — it is the restore TEMPLATE: dtypes, shapes, and
+        shardings of every leaf pin where the restored data lands.
+        When the saved leaf shapes (manifest) differ from the
+        template's — the surviving mesh is a different size — the
+        restore routes through :func:`ft.reshard.reshard_state`: the
+        state is read via an abstract template of the SAVED shapes and
+        every ``[n, k]`` shard row-refit onto the template's
+        ``[m, k']`` layout."""
+        import jax
+
+        step = self.ckpt.latest_step()
+        if step is None:
+            return init_state, 0
+        man = read_manifest(self._dir)
+        saved_shapes = (man or {}).get("leaf_shapes")
+        tmpl_leaves, treedef = jax.tree_util.tree_flatten(init_state)
+        cross_mesh = (
+            saved_shapes is not None
+            and len(saved_shapes) == len(tmpl_leaves)
+            and any(
+                tuple(sh) != tuple(np.shape(leaf))
+                for (sh, _), leaf in zip(saved_shapes, tmpl_leaves)
+            )
+        )
+        if cross_mesh:
+            from ddl25spring_tpu.ft import reshard
+
+            # sharding-less abstract leaves: orbax re-reads the SAVED
+            # shardings from the step dir (it warns about topology
+            # safety — correctly, and irrelevantly: every leaf is
+            # re-placed per the template by reshard_state immediately)
+            abstract = treedef.unflatten([
+                jax.ShapeDtypeStruct(tuple(sh), np.dtype(dt))
+                for sh, dt in saved_shapes
+            ])
+            raw = self.ckpt.restore(step, template=abstract)
+            state = reshard.reshard_state(raw, init_state)
+        else:
+            state = self.ckpt.restore(step, template=init_state)
+        self._last_requested = step  # resaving continues from here
+        self._mark_durable(step)
+        flight.record(
+            kind="restore", step=step, cross_mesh=bool(cross_mesh)
+        )
+        flight.annotate(resumed_from_step=step)
+        log.warning(
+            "autosave: resumed from step %d (%s) — next step %d",
+            step, "cross-mesh reshard" if cross_mesh else "same mesh",
+            step + 1,
+        )
+        return state, step + 1
+
+    # ---- lifecycle ------------------------------------------------------
+
+    def close(self, timeout_s: float | None = None) -> bool:
+        """Barrier the in-flight save (bounded), finalize the manifest,
+        release orbax.  Idempotent — it runs on the flight recorder's
+        shutdown chain, where SIGTERM and atexit may both arrive."""
+        if self._closed:
+            return True
+        self._closed = True
+        flight.unregister_shutdown(self._hook_name)
+        drained = self.ckpt.close(
+            timeout_s if timeout_s is not None else self.close_timeout_s
+        )
+        if drained and self._last_requested is not None:
+            self._mark_durable(self._last_requested)
+        elif not drained:
+            log.warning(
+                "autosave: close barrier timed out — last durable step "
+                "stays %s (requested %s)",
+                self._last_durable, self._last_requested,
+            )
+        self._write_manifest()
+        return drained

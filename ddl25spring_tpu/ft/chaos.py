@@ -1,0 +1,261 @@
+"""Deterministic chaos injection: faults at exact step numbers.
+
+Every recovery claim this package makes — "a SIGTERM'd run resumes from
+its last durable checkpoint", "a NaN step is never persisted" — is only
+falsifiable if the failure itself is reproducible.  This module is that
+reproducer: a fault injector armed from one env spec
+(``DDL25_CHAOS=sigterm@12``) that fires *at an exact train-step index*,
+so a kill-and-resume test is a deterministic program, not a race.
+
+Spec grammar (``DDL25_CHAOS``, or any string handed to
+:func:`parse_chaos`)::
+
+    <kind>@<step>[,<kind>@<step>...]
+
+    sigterm@12      os.kill(self, SIGTERM) after step 12 completes —
+                    the scheduler-preemption path (the flight
+                    recorder's handler dumps, barriers checkpoints via
+                    its shutdown hooks, exits 143)
+    kill@7          SIGKILL after step 7 — the brutal death: no
+                    handler, no cleanup, async saves die mid-write
+    nan_grad@5      the batch FED TO step 5 has every float leaf
+                    poisoned to NaN — loss and grads go non-finite
+                    inside the compiled step, which is exactly what
+                    the PR-5 sentinels exist to observe
+    device_loss@9   raise :class:`DeviceLossError` after step 9 — the
+                    simulated hardware-churn path; ``bench.py``
+                    classifies it ``device_unreachable`` and its retry
+                    driver relaunches with ``--resume-from``
+
+Timing contract: ``kill``-type faults (sigterm / kill / device_loss)
+fire in :meth:`ChaosInjector.on_step` — *after* step ``k``'s dispatch
+returns and *before* the step-``k`` checkpoint decision, so the state
+of step ``k`` is never durable at death (maximum honest replay).
+``nan_grad`` is pre-step by nature: :meth:`ChaosInjector.poison_batch`
+rewrites the batch consumed by step ``k`` itself.
+
+One-shot across relaunches: a resumed process replays the armed step
+index, so a fault that re-fired would preempt the run forever.  Fired
+faults are therefore journaled to ``chaos_fired.jsonl`` under
+``state_dir`` (written *before* the fault executes — a SIGKILL must not
+lose the record) and skipped by any later injector reading the same
+directory.  A fresh run wipes its checkpoint dir and the journal with
+it.  Without a ``state_dir`` every process re-arms from the spec alone
+(documented footgun; the bench and demo drivers always pass one).
+
+Host-only by construction (like the flight recorder): nothing here
+enters a traced program, so the HLO-identity contracts of the obs stack
+are untouched.  The sole device-visible effect is the NaN batch —
+ordinary data as far as XLA is concerned.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+from dataclasses import dataclass
+
+log = logging.getLogger(__name__)
+
+KINDS = ("sigterm", "kill", "nan_grad", "device_loss")
+CHAOS_ENV = "DDL25_CHAOS"
+FIRED_BASENAME = "chaos_fired.jsonl"
+
+
+class DeviceLossError(RuntimeError):
+    """Simulated device loss (``device_loss@k``).  The message carries
+    the ``device loss`` marker ``bench.classify_failure`` maps to
+    ``device_unreachable`` — the retry driver treats it exactly like a
+    real hardware disappearance."""
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str
+    step: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}@{self.step}"
+
+
+def parse_chaos(spec: str | None) -> tuple[Fault, ...]:
+    """Parse a chaos spec string into faults.  Empty/None -> no faults;
+    a malformed entry raises immediately (a typo'd fault silently not
+    firing is a test that proves nothing)."""
+    if not spec:
+        return ()
+    faults = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, sep, step_s = entry.partition("@")
+        if not sep or not step_s:
+            raise ValueError(
+                f"chaos entry {entry!r} is not <kind>@<step> "
+                f"(spec {spec!r})"
+            )
+        if kind not in KINDS:
+            raise ValueError(
+                f"chaos kind {kind!r} is not one of {sorted(KINDS)} "
+                f"(spec {spec!r})"
+            )
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"chaos step {step_s!r} is not an integer (spec {spec!r})"
+            ) from None
+        if step < 0:
+            raise ValueError(f"chaos step must be >= 0, got {step}")
+        faults.append(Fault(kind, step))
+    return tuple(faults)
+
+
+class ChaosInjector:
+    """Arm faults from a spec; fire them at exact step indices.
+
+    Wiring contract (both ``bench.py`` and ``ft/demo.py`` follow it)::
+
+        chaos = ChaosInjector.from_env(state_dir=ckpt_dir)
+        for i in range(start, steps):
+            batch = chaos.poison_batch(data_at(i), i)   # nan_grad
+            params, opt, loss = step(params, opt, batch)
+            chaos.on_step(i)                            # kill-type
+            saver.maybe_save(i, ...)
+
+    Every fired fault is journaled (one-shot across relaunches, see
+    module docstring) and recorded into the flight ring
+    (``kind="chaos"``) so a post-mortem names the injection alongside
+    the death it caused.
+    """
+
+    def __init__(
+        self,
+        faults: tuple[Fault, ...] | list[Fault] = (),
+        state_dir: str | os.PathLike | None = None,
+    ):
+        self.faults = tuple(faults)
+        self._state_path = (
+            os.path.join(str(state_dir), FIRED_BASENAME)
+            if state_dir is not None else None
+        )
+        self._fired: set[str] = set()
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        self._fired.add(json.loads(line)["fault"])
+                    except (ValueError, KeyError, TypeError):
+                        # a torn trailing line (the process died MID-
+                        # journal — exactly the event class this package
+                        # simulates) must not crash-loop every relaunch;
+                        # worst case the half-recorded fault re-fires
+                        # once
+                        log.warning(
+                            "chaos: skipping torn journal line in %s",
+                            self._state_path,
+                        )
+
+    @classmethod
+    def from_env(
+        cls, state_dir: str | os.PathLike | None = None
+    ) -> "ChaosInjector":
+        """The driver entry: arm from ``DDL25_CHAOS`` (host-only driver
+        code — trace-time env reads stay behind ``utils.config``)."""
+        return cls(parse_chaos(os.environ.get(CHAOS_ENV)), state_dir)
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    @property
+    def spec(self) -> str:
+        return ",".join(f.key for f in self.faults)
+
+    def pending(self, kind: str | None = None) -> tuple[Fault, ...]:
+        """Armed faults that have not fired yet (optionally one kind)."""
+        return tuple(
+            f for f in self.faults
+            if f.key not in self._fired and (kind is None or f.kind == kind)
+        )
+
+    def _mark_fired(self, fault: Fault) -> None:
+        # journal BEFORE executing: a SIGKILL two lines later must not
+        # erase the memory that this fault already fired
+        self._fired.add(fault.key)
+        if self._state_path:
+            os.makedirs(os.path.dirname(self._state_path), exist_ok=True)
+            with open(self._state_path, "a") as f:
+                f.write(json.dumps({"fault": fault.key}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        from ddl25spring_tpu.obs.recorder import flight
+
+        flight.record(kind="chaos", fault=fault.kind, step=fault.step)
+
+    # ---- pre-step: data poisoning ---------------------------------------
+
+    def poison_batch(self, batch, step: int):
+        """Return ``batch`` with every float leaf NaN-filled when a
+        ``nan_grad`` fault is armed for ``step``; unchanged otherwise.
+        Integer-only batches (e.g. the bench's raw uint8 images) cannot
+        carry a NaN — the fault is skipped with a warning instead of
+        silently claiming an injection that never happened."""
+        hits = [f for f in self.pending("nan_grad") if f.step == step]
+        if not hits:
+            return batch
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        poisoned = [False]
+
+        def poison(leaf):
+            if np.issubdtype(jnp.result_type(leaf), np.floating):
+                poisoned[0] = True
+                return jnp.full_like(leaf, jnp.nan)
+            return leaf
+
+        out = jax.tree.map(poison, batch)
+        for f in hits:
+            if poisoned[0]:
+                self._mark_fired(f)
+                log.warning(
+                    "chaos: nan_grad@%d — float batch leaves poisoned", step
+                )
+            else:
+                log.warning(
+                    "chaos: nan_grad@%d armed but the batch has no float "
+                    "leaves (uint8 input path?); fault skipped", step,
+                )
+        return out if poisoned[0] else batch
+
+    # ---- post-step: kill-type faults ------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Fire any armed kill-type fault for ``step`` (called after the
+        step's dispatch returns; see the module timing contract)."""
+        for f in self.pending():
+            if f.step != step or f.kind == "nan_grad":
+                continue
+            self._mark_fired(f)
+            if f.kind == "sigterm":
+                log.warning("chaos: sigterm@%d — SIGTERM to self", step)
+                os.kill(os.getpid(), signal.SIGTERM)
+                # with a handler installed (flight recorder) this call
+                # never returns; without one the default action kills
+                # at the next bytecode boundary
+            elif f.kind == "kill":
+                log.warning("chaos: kill@%d — SIGKILL to self", step)
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "device_loss":
+                raise DeviceLossError(
+                    f"chaos: simulated device loss after step {step} — "
+                    "device unreachable"
+                )

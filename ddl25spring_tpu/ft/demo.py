@@ -1,0 +1,154 @@
+"""Minimal deterministic resumable training loop — the ft/ test vehicle.
+
+A tiny DP MLP regression whose ENTIRE state trajectory is a pure
+function of ``(rng_seed, step)``: the batch consumed at step ``i`` is
+generated from ``fold_in(data_key, cursor)`` where the cursor is part
+of the checkpointed resume bundle.  That makes the package's central
+claim mechanically checkable from the outside::
+
+    python -m ddl25spring_tpu.ft.demo --steps 8 --out ref.npz ...
+    DDL25_CHAOS=kill@6 python -m ddl25spring_tpu.ft.demo ... # dies -9
+    python -m ddl25spring_tpu.ft.demo ...                    # resumes
+    # ref.npz == the resumed run's npz, BITWISE (DP is deterministic)
+
+If the data cursor or rng seed failed to round-trip through the
+checkpoint, the resumed run would consume different batches and the
+final params would diverge — the equivalence test in
+``tests/test_ft.py`` is sensitive to exactly the state the
+``checkpoint.py`` docstring promises to save.
+
+Runs standalone in a subprocess (forces its own CPU mesh; the test
+harness SIGKILLs it mid-run), prints greppable ``FT-DEMO`` marker
+lines, and wires the full production path: flight recorder installed,
+chaos armed from ``DDL25_CHAOS`` (one-shot journal in the ckpt dir),
+sentinel-gated autosave, auto-resume from the latest durable step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--run-dir", default=None,
+                    help="flight.json dump dir (default: DDL25_FLIGHT_DIR)")
+    ap.add_argument("--out", default=None,
+                    help="write final params as .npz here")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="forced CPU device count (the DP mesh size)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--sync-saves", action="store_true",
+                    help="synchronous checkpointing: every save durable "
+                         "before the next step (deterministic tests)")
+    args = ap.parse_args(argv)
+
+    # the equivalence oracle reuses trees across steps; donation would
+    # invalidate them (same opt-out the test conftest makes)
+    os.environ.setdefault("DDL25_DONATE", "0")
+    from ddl25spring_tpu.utils.platform import force_cpu_devices
+
+    force_cpu_devices(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddl25spring_tpu.ft import AutoSaver, ChaosInjector, resume_bundle
+    from ddl25spring_tpu.obs import flight
+    from ddl25spring_tpu.parallel.dp import make_dp_train_step
+    from ddl25spring_tpu.utils.mesh import make_mesh
+
+    flight.configure(run_dir=args.run_dir)
+    flight.install()  # SIGTERM/excepthook/atexit: dump + ckpt barrier
+    flight.annotate(driver="ft-demo", steps=args.steps, seed=args.seed)
+
+    mesh = make_mesh(jax.devices()[: args.devices], data=args.devices)
+    tx = optax.adam(1e-2)
+    init_key = jax.random.PRNGKey(args.seed)
+    params = {
+        "w1": jax.random.normal(jax.random.fold_in(init_key, 1), (16, 32))
+        * 0.1,
+        "w2": jax.random.normal(jax.random.fold_in(init_key, 2), (32, 4))
+        * 0.1,
+    }
+
+    def loss_fn(p, batch, key):
+        del key
+        x, y = batch
+        return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+    step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+    step_key = jax.random.PRNGKey(0)
+
+    def data_at(data_key, cursor: int):
+        """The deterministic input stream: batch ``cursor`` is a pure
+        function of the checkpointed rng seed + data cursor."""
+        k = jax.random.fold_in(data_key, cursor)
+        x = jax.random.normal(jax.random.fold_in(k, 0),
+                              (args.batch, 16), jnp.float32)
+        y = jax.random.normal(jax.random.fold_in(k, 1),
+                              (args.batch, 4), jnp.float32)
+        return x, y
+
+    saver = AutoSaver(
+        args.ckpt_dir, save_every=args.save_every,
+        max_to_keep=10, async_save=not args.sync_saves,
+        meta={"driver": "ft-demo", "steps": args.steps},
+    )
+    chaos = ChaosInjector.from_env(state_dir=args.ckpt_dir)
+
+    from ddl25spring_tpu.utils.checkpoint import with_mesh_placement
+
+    # the template pins placement: restored leaves must land replicated
+    # over the DP mesh, not committed to the default device
+    init = with_mesh_placement(
+        resume_bundle(params, tx.init(params),
+                      data_cursor=0, rng_seed=args.seed),
+        mesh,
+    )
+    state, start = saver.restore_or_init(init)
+    p, o = state["params"], state["opt_state"]
+    cursor = int(state["data_cursor"])
+    # the RESTORED seed is authoritative from here on — re-persisting
+    # args.seed would desync a second resume's data stream when the
+    # relaunch was (mis)launched with a different --seed
+    rng_seed = int(state["rng_seed"])
+    data_key = jax.random.PRNGKey(rng_seed)
+    print(f"FT-DEMO start={start} cursor={cursor} "
+          f"durable={saver.ckpt.latest_step()}", flush=True)
+
+    loss = None
+    for i in range(start, args.steps):
+        batch = chaos.poison_batch(data_at(data_key, cursor), i)
+        p, o, loss = step(p, o, batch, step_key)
+        lval = float(loss)  # force completion (and the sentinel callback)
+        cursor += 1
+        flight.record(kind="step", strategy="ft-demo", step=i, loss=lval)
+        chaos.on_step(i)  # kill-type faults: AFTER the step, BEFORE save
+        saver.maybe_save(
+            i,
+            resume_bundle(p, o, data_cursor=cursor, rng_seed=rng_seed),
+            loss=lval,
+        )
+    saver.close()
+
+    if args.out:
+        flat = {
+            jax.tree_util.keystr(path): np.asarray(leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(p)[0]
+        }
+        np.savez(args.out, **flat)
+    print(f"FT-DEMO done steps={args.steps} "
+          f"loss={None if loss is None else float(loss)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,66 @@
+"""The autosave resume manifest + durable-step scan — pure stdlib.
+
+Deliberately free of jax/orbax imports: the two consumers that poll
+these facts must stay lightweight —
+
+- the bench retry driver reads :func:`latest_durable_step` between
+  relaunches to decide whether the next attempt can ``--resume-from``
+  (it must not drag a CheckpointManager into the parent process);
+- the post-mortem report (``obs/report.py`` / ``tools/obs_report.py``)
+  reads :func:`read_manifest` to render the "Recovery" section, and a
+  post-mortem tool must keep working on a box where orbax is broken —
+  that can be exactly what died.
+
+:class:`ft.autosave.AutoSaver` is the writer; see its module docstring
+for what the manifest records and when steps become durable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from pathlib import Path
+
+MANIFEST_BASENAME = "manifest.json"
+
+
+def write_manifest(directory: str | os.PathLike, doc: dict) -> str:
+    """Atomically write ``manifest.json`` (temp + rename; pid+tid in the
+    temp name — the shutdown hook and the main loop may race)."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / MANIFEST_BASENAME
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return str(path)
+
+
+def read_manifest(directory: str | os.PathLike) -> dict | None:
+    """Read ``manifest.json``; None when absent or unreadable (a
+    truncated manifest must degrade to the orbax directory scan, not
+    kill the resume)."""
+    path = Path(directory) / MANIFEST_BASENAME
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def latest_durable_step(directory: str | os.PathLike) -> int | None:
+    """The newest COMMITTED checkpoint step, by directory scan alone.
+
+    Orbax commits a step by renaming its ``<step>.orbax-checkpoint-
+    tmp-*`` staging dir to the bare ``<step>`` name, so a digit-named
+    directory IS a durable step and an interrupted save is invisible
+    (pinned in ``tests/test_ft.py``)."""
+    d = Path(directory)
+    if not d.is_dir():
+        return None
+    steps = [
+        int(p.name) for p in d.iterdir() if p.is_dir() and p.name.isdigit()
+    ]
+    return max(steps) if steps else None

@@ -1,0 +1,125 @@
+"""Cross-mesh restore: re-land checkpointed shards on a different mesh.
+
+A ZeRO-style checkpoint is mesh-shaped: every parameter (and Adam
+moment) leaf was saved in the padded ``[n, k]`` row layout of
+``parallel.zero`` — device ``i`` of the *saving* mesh held row ``i``.
+After a preemption the surviving slice may be smaller (8 chips die, 4
+come back), so the restore must re-shard ``[n, k]`` state onto an
+``[m, k']`` template without a round-trip through training code.  The
+weight-update-sharding math (arXiv:2004.13336) makes this purely a
+layout problem, and the padding discipline of ``zero_shard_params``
+makes it *exact*:
+
+- the flat ``[n, k]`` buffer is the true parameter vector (length
+  ``s``) padded with zeros to ``n*k``, then reshaped row-major — all
+  padding sits at the TAIL of the flattened buffer;
+- the target ``[m, k']`` layout has ``k' = ceil(s/m)``, so
+  ``m*k' >= s`` always: copying ``min(n*k, m*k')`` leading elements
+  and zero-filling the rest preserves every true element without ever
+  needing to know ``s``;
+- any nonzero element that WOULD be dropped is, by construction, real
+  data under a wrong template — :func:`reshard_state` refuses loudly
+  instead of silently truncating.
+
+The same rule re-lands the layer-stacked ``[L, n, k]`` leaves of the
+scanned-LLaMA ZeRO-3 layout (per-layer refit along the last two dims)
+and passes scalars / already-matching leaves straight through to the
+template's sharding — so one function serves ZeRO-1/2 (sharded opt
+state under replicated params) and ZeRO-3 (everything sharded) alike.
+
+Template-driven by design: the caller builds the *target* state exactly
+as a fresh run would (``zero.zero_resume_template`` /
+``checkpoint.with_mesh_placement``), and every restored leaf comes back
+carrying the template leaf's ``NamedSharding`` — the resumed ``jit``
+sees placements indistinguishable from a run that never died.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _refit_flat(flat: np.ndarray, target_len: int, name: str) -> np.ndarray:
+    """Zero-pad or zero-truncate a flattened shard buffer to
+    ``target_len``.  Truncation is only legal over the zero padding
+    tail; a nonzero casualty means the template does not describe the
+    same parameter — refuse."""
+    if flat.size == target_len:
+        return flat
+    if flat.size > target_len:
+        dropped = flat[target_len:]
+        if np.any(dropped != 0):
+            raise ValueError(
+                f"cross-mesh refit of {name}: {flat.size} -> {target_len} "
+                f"elements would drop {int(np.count_nonzero(dropped))} "
+                "nonzero values — the template's shard layout is smaller "
+                "than the saved parameter (mismatched model?)"
+            )
+        return flat[:target_len]
+    out = np.zeros(target_len, dtype=flat.dtype)
+    out[: flat.size] = flat
+    return out
+
+
+def reshard_leaf(saved, template, name: str = "<leaf>"):
+    """Refit one saved leaf onto one template leaf's shape + placement.
+
+    - same shape: pass through (dtype-cast to the template's);
+    - 2-D ``[n, k] -> [m, k']``: flatten (row-major == the padded flat
+      vector), refit, reshape;
+    - 3-D ``[L, n, k] -> [L, m, k']``: per-layer refit along the
+      trailing dims (the scanned-LLaMA block layout);
+    - anything else: refuse — a rank change is not a mesh change.
+
+    The result lands with the template leaf's sharding when it has one
+    (host arrays / ShapeDtypeStructs without shardings stay host-side).
+    """
+    arr = np.asarray(saved)
+    tshape = tuple(template.shape)
+    tdtype = np.dtype(template.dtype)
+    if arr.shape == tshape:
+        out = arr.astype(tdtype, copy=False)
+    elif arr.ndim == 2 and len(tshape) == 2:
+        out = _refit_flat(
+            arr.reshape(-1), int(np.prod(tshape)), name
+        ).reshape(tshape).astype(tdtype, copy=False)
+    elif arr.ndim == 3 and len(tshape) == 3 and arr.shape[0] == tshape[0]:
+        L = arr.shape[0]
+        rows = int(np.prod(tshape[1:]))
+        out = np.stack(
+            [_refit_flat(arr[i].reshape(-1), rows, f"{name}[layer {i}]")
+             for i in range(L)]
+        ).reshape(tshape).astype(tdtype, copy=False)
+    else:
+        raise ValueError(
+            f"cannot reshard {name}: saved shape {arr.shape} does not map "
+            f"onto template shape {tshape} (rank/leading-dim mismatch)"
+        )
+    sharding = getattr(template, "sharding", None)
+    if sharding is not None:
+        return jax.device_put(out, sharding)
+    return out
+
+
+def reshard_state(saved_tree: Any, template_tree: Any) -> Any:
+    """Refit a whole restored state pytree onto a template pytree.
+
+    ``saved_tree`` must share the template's treedef (the autosave
+    layer restores through an abstract template built from the
+    manifest's recorded leaf shapes, so the structures always match);
+    every leaf goes through :func:`reshard_leaf` and comes back placed
+    per the template.  This is the one entry
+    :meth:`ft.autosave.AutoSaver.restore_or_init` uses for both the
+    same-mesh and the shrunk-mesh cases — matched shapes degenerate to
+    a placement pass-through.
+    """
+    flat_t, treedef = jax.tree_util.tree_flatten_with_path(template_tree)
+    flat_s = treedef.flatten_up_to(saved_tree)
+    out = [
+        reshard_leaf(s, t, name=jax.tree_util.keystr(path))
+        for (path, t), s in zip(flat_t, flat_s)
+    ]
+    return treedef.unflatten(out)

@@ -76,15 +76,28 @@ class FlightRecorder:
     """Thread-safe ring buffer of run-health records + dump machinery."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._lock = threading.Lock()
+        # REENTRANT on purpose: the SIGTERM handler runs on the main
+        # thread and walks the shutdown hooks + dump() — both of which
+        # take this lock — and the signal can land while that same
+        # thread is inside record()'s critical section (it runs every
+        # step).  A plain Lock would self-deadlock the preemption path;
+        # reentrancy at worst lets the handler observe a half-applied
+        # record update (an off-by-one "recorded" count in the dump),
+        # which a dying process tolerates.
+        self._lock = threading.RLock()
         self._records: deque[dict] = deque(maxlen=capacity)
         self._meta: dict[str, Any] = {}
         self._seq = 0
-        # cumulative, ring-eviction-proof: a violation recorded 1000
-        # steps ago must still fail --check-health even after the ring
-        # rolled past it
-        self._counts = {"violation": 0, "stall": 0}
+        # cumulative per-kind counters, ring-eviction-proof: a violation
+        # recorded 1000 steps ago must still fail --check-health even
+        # after the ring rolled past it, and the recovery report counts
+        # saves/restores the same way
+        self._counts: dict[str, int] = {}
         self._last: dict[str, dict] = {}
+        # shutdown hooks: callables the crash paths run BEFORE dumping
+        # (checkpoint barriers, flushes) so the dump names what they
+        # made durable — see register_shutdown
+        self._shutdown_hooks: dict[str, Any] = {}
         self._run_dir: str | None = None
         self._t0 = time.perf_counter()
         self._last_beat = time.perf_counter()
@@ -131,9 +144,8 @@ class FlightRecorder:
             }
             self._seq += 1
             self._records.append(rec)
-            if kind in self._counts:
-                self._counts[kind] += 1
-                self._last[kind] = rec
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._last[kind] = rec
             if touch:
                 self._last_beat = now
         return rec
@@ -152,14 +164,21 @@ class FlightRecorder:
             recs = list(self._records)
         return recs if n is None else recs[-n:]
 
+    def counts(self) -> dict[str, int]:
+        """Cumulative per-kind record counts (O(kinds), no ring copy) —
+        the cheap poll the autosave gate and telemetry cells use."""
+        with self._lock:
+            return dict(self._counts)
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             return {
                 "meta": dict(self._meta),
                 "capacity": self._records.maxlen,
                 "recorded": self._seq,
-                "violations": self._counts["violation"],
-                "stalls": self._counts["stall"],
+                "violations": self._counts.get("violation", 0),
+                "stalls": self._counts.get("stall", 0),
+                "counts": dict(self._counts),
                 **{
                     f"last_{k}": dict(r) for k, r in self._last.items()
                 },
@@ -171,8 +190,9 @@ class FlightRecorder:
             self._records.clear()
             self._meta.clear()
             self._seq = 0
-            self._counts = {"violation": 0, "stall": 0}
+            self._counts = {}
             self._last.clear()
+            self._shutdown_hooks.clear()
             self._dumped_seq = -1
             self._t0 = time.perf_counter()
             self._last_beat = time.perf_counter()
@@ -225,6 +245,39 @@ class FlightRecorder:
             self._dumped_seq = max(self._dumped_seq, doc["recorded"])
         return path
 
+    # ---- shutdown hooks -------------------------------------------------
+
+    def register_shutdown(self, fn, name: str | None = None) -> str:
+        """Chain ``fn`` into every crash path this recorder owns —
+        excepthook, SIGTERM, atexit — running BEFORE the flight dump so
+        the dump records what the hook made durable.  The canonical
+        client is :meth:`ft.autosave.AutoSaver.close`: a SIGTERM'd run
+        barriers its in-flight checkpoint instead of truncating it.
+
+        Hooks must bound their own runtime (a wedged hook on the
+        SIGTERM path would out-wait the scheduler's kill grace — the
+        autosave barrier takes a timeout for exactly this reason) and
+        be idempotent (the atexit pass runs them again after a SIGTERM
+        that chose not to exit).  Returns the registration name for
+        :meth:`unregister_shutdown`."""
+        name = name or f"hook-{id(fn):x}"
+        with self._lock:
+            self._shutdown_hooks[name] = fn
+        return name
+
+    def unregister_shutdown(self, name: str) -> None:
+        with self._lock:
+            self._shutdown_hooks.pop(name, None)
+
+    def _run_shutdown_hooks(self, reason: str) -> None:
+        del reason  # all paths run all hooks; the arg documents call sites
+        with self._lock:
+            hooks = list(self._shutdown_hooks.values())
+        for fn in hooks:
+            # a failing hook must cost neither the dump nor its peers
+            with contextlib.suppress(Exception):
+                fn()
+
     # ---- crash handlers -------------------------------------------------
 
     def install(self, run_dir: str | None = None) -> None:
@@ -239,8 +292,9 @@ class FlightRecorder:
         self._prev_excepthook = sys.excepthook
 
         def _hook(exc_type, exc, tb):
-            # whatever dump() hits, the original exception must still
-            # reach the user
+            # whatever the hooks or dump() hit, the original exception
+            # must still reach the user
+            self._run_shutdown_hooks("unhandled_exception")
             with contextlib.suppress(Exception):
                 self.dump(
                     reason="unhandled_exception",
@@ -254,7 +308,10 @@ class FlightRecorder:
             prev = signal.getsignal(signal.SIGTERM)
 
             def _on_term(signum, frame):
-                # a failed dump must not break signal handling
+                # barrier checkpoints FIRST (each hook bounds itself),
+                # so the dump below names the truly durable step; a
+                # failed dump must not break signal handling
+                self._run_shutdown_hooks("sigterm")
                 with contextlib.suppress(Exception):
                     self.dump(reason="sigterm")
                 if prev is signal.SIG_IGN:
@@ -284,6 +341,10 @@ class FlightRecorder:
         atexit.register(self._atexit_dump)
 
     def _atexit_dump(self) -> None:
+        # hooks run UNCONDITIONALLY (they are idempotent by contract):
+        # an exiting run whose records were already dumped still owes
+        # its checkpoint barrier
+        self._run_shutdown_hooks("atexit")
         with self._lock:
             pending = self._seq > self._dumped_seq and self._seq > 0
         if pending:

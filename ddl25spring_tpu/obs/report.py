@@ -173,11 +173,64 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 "last_records": (fl.get("records") or [])[-5:],
                 "exception": fl.get("exception"),
             }
+            # recovery facts (the ft/ layer): the flight meta carries
+            # the durable-checkpoint annotations and the per-kind
+            # counters carry save/restore traffic — enough to answer
+            # "what survived" from the dump alone
+            meta = fl.get("meta") or {}
+            counts = fl.get("counts") or {}
+            recovery = {
+                k: meta[k]
+                for k in (
+                    "ckpt_dir",
+                    "ckpt_last_durable_step",
+                    "resumed_from_step",
+                    "steps_replayed",
+                )
+                if meta.get(k) is not None
+            }
+            for kind, label in (
+                ("save", "saves"),
+                ("save_skipped", "saves_skipped"),
+                ("restore", "restores"),
+                ("chaos", "chaos_faults"),
+            ):
+                if counts.get(kind):
+                    recovery[label] = counts[kind]
+            if recovery:
+                out["recovery"] = recovery
         except (json.JSONDecodeError, OSError) as e:
             # a truncated dump must not cost the measured metrics
             out["health"] = {
                 "error": f"unreadable {FLIGHT_BASENAME}: {e}"
             }
+
+    # the autosave manifest (run_dir/ckpt by bench convention, or
+    # wherever the flight meta points): the checkpoint layer's own
+    # account of the last durable step — readable even when the crash
+    # never managed a flight dump (ft.manifest is stdlib-only: the
+    # post-mortem must work even where orbax itself is what broke)
+    from ddl25spring_tpu.ft.manifest import read_manifest
+
+    # the flight meta's recorded ckpt_dir is authoritative (a custom
+    # --ckpt-dir run must not be shadowed by a stale manifest sitting
+    # at the default location); the run_dir/ckpt convention is the
+    # fallback for dumps that never got annotated
+    rec_dir = (out.get("recovery") or {}).get("ckpt_dir")
+    ckpt_dirs = ([rec_dir] if rec_dir else []) + [
+        os.path.join(run_dir, "ckpt")
+    ]
+    for cd in ckpt_dirs:
+        man = read_manifest(cd)
+        if man is not None:
+            rec = out.setdefault("recovery", {})
+            rec["manifest"] = {
+                k: man.get(k)
+                for k in ("last_durable_step", "last_requested_step",
+                          "save_every", "saves", "save_skipped")
+            }
+            rec.setdefault("ckpt_dir", cd)
+            break
 
     # compile-time analytics, when a bench/CLI run dropped its report here
     # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
@@ -327,6 +380,37 @@ def format_report(summary: dict[str, Any]) -> str:
                     if k in r
                 )
                 lines.append(f"  [{r.get('kind', 'step')}] {bits}")
+
+    rec = summary.get("recovery")
+    if rec:
+        lines.append("")
+        lines.append("recovery (ft/ autosave + flight meta — what survived):")
+        man = rec.get("manifest") or {}
+        durable = rec.get("ckpt_last_durable_step",
+                          man.get("last_durable_step"))
+        bits = [f"last durable step: {durable}"]
+        if rec.get("ckpt_dir"):
+            bits.append(f"ckpt: {rec['ckpt_dir']}")
+        lines.append("  " + "  ".join(bits))
+        if rec.get("resumed_from_step") is not None:
+            replay = rec.get("steps_replayed")
+            lines.append(
+                f"  resumed from step {rec['resumed_from_step']}"
+                + (f"  ({replay} step(s) replayed)"
+                   if replay is not None else "")
+            )
+        counts_bits = [
+            f"{k}={rec[k]}"
+            for k in ("saves", "saves_skipped", "restores", "chaos_faults")
+            if rec.get(k) is not None
+        ]
+        if man.get("save_skipped"):
+            counts_bits.append(
+                f"manifest save_skipped={man['save_skipped']} "
+                "(poisoned-checkpoint gate)"
+            )
+        if counts_bits:
+            lines.append("  " + "  ".join(counts_bits))
 
     cr = summary.get("compile_report")
     if cr:

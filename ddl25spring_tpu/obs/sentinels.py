@@ -69,6 +69,7 @@ _policy: str = env_choice("DDL25_SENTINEL_POLICY", POLICIES, "log")
 _lock = threading.Lock()
 _steps: dict[str, int] = {}  # host-side per-strategy step counter
 _last_violation: dict | None = None
+_violation_total: int = 0  # cumulative; the ft/ autosave gate polls it
 
 
 class SentinelViolation(FloatingPointError):
@@ -147,12 +148,22 @@ def last_violation() -> dict | None:
         return dict(_last_violation) if _last_violation else None
 
 
+def violation_count() -> int:
+    """Cumulative violations observed in this process (all strategies).
+    The poisoned-checkpoint gate (:mod:`ddl25spring_tpu.ft.autosave`)
+    compares this across save attempts: a step flagged non-finite since
+    the last save means the pending state must not be persisted."""
+    with _lock:
+        return _violation_total
+
+
 def reset() -> None:
     """Clear host-side step counters + last violation (test harness)."""
-    global _last_violation
+    global _last_violation, _violation_total
     with _lock:
         _steps.clear()
         _last_violation = None
+        _violation_total = 0
 
 
 # --------------------------------------------------------------- the guard
@@ -305,7 +316,7 @@ def _on_step(
         flight.beat()
         return
 
-    global _last_violation
+    global _last_violation, _violation_total
     loss = float(loss)
     gnorm = math.sqrt(g2) if (g2 := float(gnorm2)) >= 0 else None
     u2, p2 = float(unorm2), float(pnorm2)
@@ -355,6 +366,7 @@ def _on_step(
     _counters.add("sentinel.violations", 1.0)
     with _lock:
         _last_violation = dict(rec)
+        _violation_total += 1
 
     msg = (
         f"sentinel violation in strategy={strategy!r} step={step}: "

@@ -587,6 +587,37 @@ def zero_unshard_llama_params(shards, template):
     return out
 
 
+def zero_resume_template(
+    params_template,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis: str = "data",
+    llama: bool = False,
+):
+    """The restore template for a (possibly cross-mesh) ZeRO resume:
+    ``{"params": shards, "opt_state": tx.init(shards)}`` laid out for
+    ``mesh`` exactly as a fresh run would build it, with every
+    placement-less leaf (Adam's ``count`` scalar…) replicated via
+    :func:`~ddl25spring_tpu.utils.checkpoint.with_mesh_placement`.
+
+    Hand this (plus cursors, via ``ft.autosave.resume_bundle``) to
+    :meth:`ft.autosave.AutoSaver.restore_or_init`: when the checkpoint
+    was saved on a DIFFERENT device count, the restore re-lands each
+    saved ``[n, k]`` shard onto this template's ``[m, k']`` layout
+    through :mod:`ddl25spring_tpu.ft.reshard` — the elastic half of the
+    weight-update-sharding math (arXiv:2004.13336) this module's
+    forward/backward implements."""
+    from ddl25spring_tpu.utils.checkpoint import with_mesh_placement
+
+    shards = (
+        zero_shard_llama_params(params_template, mesh, axis)
+        if llama else zero_shard_params(params_template, mesh, axis)
+    )
+    return with_mesh_placement(
+        {"params": shards, "opt_state": tx.init(shards)}, mesh
+    )
+
+
 def make_zero3_llama_train_step(
     cfg,
     tx: optax.GradientTransformation,

@@ -14,11 +14,21 @@ recovery loop in mind:
 - ``latest_step`` + ``restore_or_init`` make the launcher logic one line:
   crashed-and-restarted processes converge to the same state as a run that
   never died (tested by the kill-and-resume equivalence test).
+
+This module is the durable-storage primitive only.  The fault-tolerance
+layer (:mod:`ddl25spring_tpu.ft`) builds the operational loop on top:
+``ft/autosave.py`` adds the save cadence, the sentinel gate that keeps a
+non-finite step out of storage, the atomic resume manifest (full resume
+state: params, opt state, step, data/rng cursors), and the
+crash-shutdown barrier; ``ft/reshard.py`` re-lands a checkpoint saved
+on ``n`` devices onto a smaller surviving mesh.
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -26,6 +36,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+log = logging.getLogger(__name__)
 
 State = Any
 
@@ -54,20 +66,31 @@ class Checkpointer:
     """Thin orbax CheckpointManager wrapper over ``{params, opt_state, ...}``
     pytrees with jax.Array / numpy leaves."""
 
-    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3):
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        max_to_keep: int = 3,
+        async_save: bool = True,
+    ):
         self._dir = Path(directory).absolute()
         self._dir.mkdir(parents=True, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=async_save,
             ),
         )
 
     def save(self, step: int, state: State, *, force: bool = False) -> None:
         """Async save: serialization overlaps subsequent training steps
-        (orbax waits for the previous save itself before starting another);
-        ``close()`` or a ``restore`` barriers on completion."""
+        (orbax snapshots device state to host synchronously, waits for
+        the PREVIOUS save before starting another, and commits each step
+        dir by atomic rename — an interrupted write leaves only an
+        ignored ``*-tmp-*`` dir); ``close()`` or a ``restore`` barriers
+        on completion.  ``async_save=False`` at construction makes every
+        save durable before this returns (the deterministic-test mode).
+        """
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
 
     def latest_step(self) -> int | None:
@@ -77,6 +100,54 @@ class Checkpointer:
         """Steps currently on disk (oldest pruned per ``max_to_keep``)."""
         self._mgr.wait_until_finished()
         return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self, timeout_s: float | None = None) -> bool:
+        """Barrier on any in-flight async save; returns True when drained.
+
+        ``timeout_s`` bounds the wait: orbax's own barrier is unbounded,
+        and a wedged serialization thread blocking process exit forever
+        is exactly the failure mode the stall watchdog exists to catch —
+        the shutdown path must not outlive it.  On timeout the orbax
+        thread is left running (daemon; it cannot be killed from here)
+        and False is returned so the caller can report the truncation
+        instead of hanging."""
+        if timeout_s is None:
+            self._mgr.wait_until_finished()
+            return True
+        done = threading.Event()
+        failure: list[BaseException] = []
+
+        def _wait():
+            try:
+                self._mgr.wait_until_finished()
+            except BaseException as e:  # noqa: BLE001 — a FAILED save
+                # must not be reported as drained: the barrier re-raises
+                # async save errors (disk full, serialization), and
+                # swallowing one here would let the caller mark a
+                # never-committed step durable
+                failure.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_wait, daemon=True, name="ckpt-wait-until-finished"
+        )
+        t.start()
+        if not done.wait(timeout_s):
+            log.warning(
+                "checkpoint barrier did not drain within %.1fs — an "
+                "orbax save thread is wedged; the last checkpoint may "
+                "be incomplete (its tmp dir stays invisible to "
+                "latest_step)", timeout_s,
+            )
+            return False
+        if failure:
+            log.warning(
+                "checkpoint barrier raised: %s — the in-flight save did "
+                "not commit", failure[0],
+            )
+            return False
+        return True
 
     def restore(self, step: int | None = None, template: State | None = None):
         """Restore ``step`` (default latest).  ``template`` — a pytree of
@@ -88,9 +159,16 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")
         if template is not None:
-            abstract = jax.tree.map(
-                lambda x: ocp.utils.to_shape_dtype_struct(x), template
-            )
+            def to_abstract(x):
+                # a ShapeDtypeStruct WITHOUT a sharding is already
+                # abstract (the cross-mesh restore path builds these
+                # from manifest shapes); orbax's own converter assumes
+                # every SDS carries one and crashes on None
+                if isinstance(x, jax.ShapeDtypeStruct) and x.sharding is None:
+                    return x
+                return ocp.utils.to_shape_dtype_struct(x)
+
+            abstract = jax.tree.map(to_abstract, template)
             args = ocp.args.StandardRestore(abstract)
         else:
             args = ocp.args.StandardRestore()
@@ -105,6 +183,12 @@ class Checkpointer:
             return init_state, 0
         return self.restore(step, template=init_state), step + 1
 
-    def close(self) -> None:
-        self._mgr.wait_until_finished()
+    def close(self, timeout_s: float | None = None) -> bool:
+        """Barrier (bounded when ``timeout_s`` is given) and release the
+        manager.  Returns False when the barrier timed out — the manager
+        is then left un-closed (closing would re-enter the unbounded
+        wait) and the in-flight save's tmp dir simply never commits."""
+        if not self.wait_until_finished(timeout_s):
+            return False
         self._mgr.close()
+        return True

@@ -1,0 +1,517 @@
+"""Fault-tolerance layer (``ddl25spring_tpu/ft``): chaos injection,
+resilient checkpointing, auto-resume, cross-mesh restore.
+
+The central pins, per the recovery contract:
+
+- **kill-and-resume equivalence**: a run SIGKILL'd mid-step by the
+  chaos injector and relaunched lands BITWISE on the params of a run
+  that never died (DP is deterministic; the restored data/rng cursors
+  are load-bearing — a broken cursor would replay different batches);
+- **SIGTERM drains the in-flight save**: the flight recorder's
+  shutdown chain barriers the async checkpoint, so preemption never
+  truncates the last save;
+- **poisoned-checkpoint prevention**: a step the sentinels flagged
+  non-finite is provably never persisted;
+- **cross-mesh restore**: ZeRO-3 state saved on 8 devices restores and
+  trains on 4, equivalent to the uninterrupted 8-way run, and the
+  resumed step's collective signature re-pins via compile analytics.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.ft import (
+    AutoSaver,
+    ChaosInjector,
+    DeviceLossError,
+    Fault,
+    latest_durable_step,
+    parse_chaos,
+    read_manifest,
+    reshard_leaf,
+    reshard_state,
+    resume_bundle,
+    write_manifest,
+)
+from ddl25spring_tpu.obs import flight, sentinels
+from ddl25spring_tpu.utils.checkpoint import Checkpointer
+from ddl25spring_tpu.utils.mesh import make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ chaos spec
+
+
+def test_parse_chaos_grammar():
+    assert parse_chaos(None) == ()
+    assert parse_chaos("") == ()
+    assert parse_chaos("sigterm@12") == (Fault("sigterm", 12),)
+    assert parse_chaos("kill@7, nan_grad@5") == (
+        Fault("kill", 7), Fault("nan_grad", 5),
+    )
+    for bad in ("boom@3", "sigterm", "sigterm@", "sigterm@x", "sigterm@-1"):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+def test_chaos_poison_device_loss_and_one_shot_journal(tmp_path):
+    ci = ChaosInjector(
+        parse_chaos("nan_grad@2,device_loss@3"), state_dir=tmp_path
+    )
+    batch = (jnp.ones((4, 3)), jnp.arange(4))
+    x1, _ = ci.poison_batch(batch, 1)
+    assert not np.isnan(np.asarray(x1)).any()  # wrong step: untouched
+    x2, y2 = ci.poison_batch(batch, 2)
+    assert np.isnan(np.asarray(x2)).all()
+    np.testing.assert_array_equal(np.asarray(y2), np.arange(4))  # int leaf
+    ci.on_step(1)  # nothing armed at 1
+    with pytest.raises(DeviceLossError, match="device unreachable"):
+        ci.on_step(3)
+    # one-shot across relaunches: a new injector reading the same
+    # journal must not re-fire either fault (a resumed run replays the
+    # armed step index — re-firing would preempt forever)
+    ci2 = ChaosInjector(
+        parse_chaos("nan_grad@2,device_loss@3"), state_dir=tmp_path
+    )
+    ci2.on_step(3)  # no raise
+    x3, _ = ci2.poison_batch(batch, 2)
+    assert not np.isnan(np.asarray(x3)).any()
+    # integer-only batches cannot carry the poison: skipped, still armed
+    ci3 = ChaosInjector(parse_chaos("nan_grad@0"), state_dir=tmp_path / "b")
+    (out,) = ci3.poison_batch((jnp.arange(4),), 0)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(4))
+    assert ci3.pending("nan_grad")
+
+
+def test_chaos_journal_tolerates_torn_line(tmp_path):
+    """A SIGKILL mid-journal leaves a partial trailing line; every later
+    relaunch must still arm (skipping the torn record) instead of
+    crash-looping before training starts."""
+    (tmp_path / "chaos_fired.jsonl").write_text(
+        '{"fault": "sigterm@5"}\n{"fault": "ki'
+    )
+    ci = ChaosInjector(parse_chaos("sigterm@5,kill@7"), state_dir=tmp_path)
+    assert [f.key for f in ci.pending()] == ["kill@7"]  # torn line skipped
+
+
+def test_classify_failure_preempted_and_device_loss():
+    import bench
+
+    assert bench.classify_failure("whatever", rc=143) == "preempted"
+    assert bench.classify_failure("whatever", rc=-15) == "preempted"
+    assert bench.classify_failure("whatever", rc=-9) == "preempted"
+    assert bench.classify_failure(
+        "chaos: simulated device loss after step 9 — device unreachable"
+    ) == "device_unreachable"
+    assert bench.classify_failure("ValueError: nope", rc=1) == "runtime_error"
+    # the parent's own timeout kill stays `stalled`, not preempted
+    assert bench.classify_failure(
+        "attempt 2: bench subprocess exceeded 2400s and was killed"
+    ) == "stalled"
+
+
+def test_flight_last_step_reader(tmp_path):
+    import bench
+
+    assert bench._flight_last_step(None) is None
+    assert bench._flight_last_step(str(tmp_path / "missing.json")) is None
+    p = tmp_path / "flight.json"
+    p.write_text(json.dumps({"dumped_at_unix": 123.5, "records": [
+        {"kind": "step", "step": 4, "wall_s": 0.1, "resumable": True},
+        {"kind": "violation", "step": 9},    # not a step record
+        {"kind": "step", "step": 11},        # sentinel record: no marker
+        {"kind": "step", "step": 30, "wall_s": 0.1},  # secondary phase:
+        # single-step units, no checkpoint alignment — must not count
+        {"kind": "step", "step": 7, "wall_s": 0.1, "resumable": True},
+    ]}))
+    assert bench._flight_last_step(str(p)) == 7
+    assert bench._flight_dump_facts(str(p)) == (123.5, 7)
+    assert bench._flight_dump_facts(None) == (None, None)
+
+
+# ---------------------------------------------------- manifest + durability
+
+
+def test_manifest_atomicity_and_tmp_dirs_invisible(tmp_path):
+    d = tmp_path / "ck"
+    write_manifest(d, {"last_durable_step": 3})
+    assert read_manifest(d)["last_durable_step"] == 3
+    # a torn temp file from an interrupted writer is not the manifest
+    (d / "manifest.json.tmp.999.1").write_text('{"last_durable')
+    assert read_manifest(d)["last_durable_step"] == 3
+    # a truncated manifest degrades to None, never an exception
+    (d / "manifest.json").write_text('{"last_durable')
+    assert read_manifest(d) is None
+    # orbax commits by rename: only digit-named dirs are durable steps —
+    # a save interrupted mid-write (still on its tmp name) is invisible
+    (d / "3").mkdir()
+    (d / "7.orbax-checkpoint-tmp-123").mkdir()
+    assert latest_durable_step(d) == 3
+    assert latest_durable_step(tmp_path / "nope") is None
+    ck = Checkpointer(tmp_path / "ck2", async_save=False)
+    ck.save(0, {"w": jnp.arange(2.0)})
+    (tmp_path / "ck2" / "9.orbax-checkpoint-tmp-1").mkdir()
+    assert ck.latest_step() == 0
+    assert latest_durable_step(tmp_path / "ck2") == 0
+    ck.close()
+
+
+def test_checkpointer_wait_timeout_bounds_a_wedged_barrier(
+    tmp_path, monkeypatch
+):
+    import time
+
+    ck = Checkpointer(tmp_path / "c", async_save=True)
+    ck.save(0, {"w": jnp.arange(4.0)})
+    assert ck.wait_until_finished(timeout_s=120.0) is True
+    # a wedged orbax thread must not outlive the watchdog: the bounded
+    # wait reports failure instead of hanging the shutdown path
+    monkeypatch.setattr(
+        ck._mgr, "wait_until_finished", lambda: time.sleep(30)
+    )
+    assert ck.wait_until_finished(timeout_s=0.2) is False
+    assert ck.close(timeout_s=0.2) is False
+    # a barrier that RAISES (failed async save) is not "drained" either
+    def _boom():
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ck._mgr, "wait_until_finished", _boom)
+    assert ck.wait_until_finished(timeout_s=5.0) is False
+
+
+def test_close_without_save_preserves_prior_manifest(tmp_path):
+    """A resumed process preempted again before its first save must not
+    clobber the lineage's manifest — leaf_shapes is what the NEXT
+    resume's cross-mesh path keys on."""
+    a = AutoSaver(tmp_path / "ck", save_every=1, async_save=False)
+    a.save(0, resume_bundle({"w": jnp.ones((4, 2))}, {}, data_cursor=1))
+    a.close()
+    man = read_manifest(tmp_path / "ck")
+    assert man["leaf_shapes"] is not None
+    saves_before = man["saves"]
+
+    b = AutoSaver(tmp_path / "ck", save_every=1)
+    b.close()  # the second preemption: shutdown hook, zero new saves
+    man2 = read_manifest(tmp_path / "ck")
+    assert man2["leaf_shapes"] == man["leaf_shapes"]
+    assert man2["saves"] == saves_before
+    assert man2["last_requested_step"] == 0
+    assert man2["last_durable_step"] == 0
+
+
+def test_flight_shutdown_hooks_run_before_dump(tmp_path):
+    from ddl25spring_tpu.obs.recorder import FlightRecorder
+
+    fr = FlightRecorder()
+    fr.configure(run_dir=str(tmp_path))
+    calls = []
+    name = fr.register_shutdown(lambda: calls.append("hook"))
+    fr.record(kind="step", step=0)
+    fr._atexit_dump()
+    assert calls == ["hook"]
+    assert (tmp_path / "flight.json").exists()
+    fr.unregister_shutdown(name)
+    fr._atexit_dump()
+    assert calls == ["hook"]  # unregistered: not run again
+
+
+def test_restore_or_init_fresh_start(tmp_path):
+    saver = AutoSaver(tmp_path / "ck", save_every=2)
+    init = resume_bundle({"w": jnp.ones((2, 2))}, {"m": jnp.zeros((2, 2))},
+                         data_cursor=0, rng_seed=1)
+    state, start = saver.restore_or_init(init)
+    assert start == 0
+    assert state is init
+    saver.close()
+
+
+def test_device_dataset_cursor_roundtrip():
+    from ddl25spring_tpu.benchmarks import DeviceDataset
+
+    ds = DeviceDataset(16, n_train=64)
+    ds.feed()
+    ds.feed()
+    c = ds.cursor
+    x1, _ = ds.feed()
+    ds.cursor = c  # the restore path: replay from the checkpointed cursor
+    x2, _ = ds.feed()
+    np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+    assert ds.cursor == c + 1
+
+
+# ----------------------------------------------------- sentinel-gated save
+
+
+def test_sentinel_flagged_step_is_never_persisted(devices8, tmp_path):
+    """The poisoned-checkpoint gate: step 2's batch is NaN-poisoned, the
+    sentinels flag it (skip policy recovers the params on device), and
+    the autosave layer provably never writes that step — while every
+    clean neighbor IS on disk."""
+    from ddl25spring_tpu.parallel.dp import make_dp_train_step
+
+    sentinels.reset()
+    skipped_before = flight.counts().get("save_skipped", 0)
+    mesh = make_mesh(devices8[:2], data=2)
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.full((8, 4), 0.5)}
+
+    def loss_fn(p, batch, key):
+        del key
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    x = jnp.ones((8, 8))
+    y = jnp.ones((8, 4))
+    key = jax.random.PRNGKey(0)
+    saver = AutoSaver(
+        tmp_path / "ck", save_every=1, max_to_keep=10, async_save=False
+    )
+    with sentinels.scoped(True, policy="skip"):
+        step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+        p, o = params, tx.init(params)
+        for i in range(6):
+            xb = x.at[0, 0].set(jnp.nan) if i == 2 else x
+            p, o, loss = step(p, o, (xb, y), key)
+            saver.maybe_save(
+                i, resume_bundle(p, o, data_cursor=i + 1), loss=float(loss)
+            )
+    saver.close()
+    steps = Checkpointer(tmp_path / "ck").steps()
+    assert 2 not in steps, steps
+    assert {0, 1, 3, 4, 5} <= set(steps)
+    # skip policy: the poisoned update never reached the params either
+    assert np.isfinite(np.asarray(p["w"])).all()
+    assert flight.counts().get("save_skipped", 0) >= skipped_before + 1
+    man = read_manifest(tmp_path / "ck")
+    assert man["save_skipped"] >= 1
+    assert man["last_durable_step"] == 5
+
+
+# -------------------------------------------------- kill-and-resume (demo)
+
+
+def _run_demo(tmp_path, ckpt, name, chaos=None, sync=True, steps=8):
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("DDL25_CHAOS", "XLA_FLAGS", "DDL25_SENTINELS")
+    }
+    if chaos:
+        env["DDL25_CHAOS"] = chaos
+    out = tmp_path / f"{name}.npz"
+    cmd = [
+        sys.executable, "-m", "ddl25spring_tpu.ft.demo",
+        "--steps", str(steps), "--save-every", "2",
+        "--ckpt-dir", str(tmp_path / ckpt),
+        "--run-dir", str(tmp_path / f"run_{name}"),
+        "--out", str(out),
+    ]
+    if sync:
+        cmd.append("--sync-saves")
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=300, cwd=REPO, env=env
+    )
+    return r, out
+
+
+def test_kill_and_resume_equivalence(tmp_path):
+    """The headline pin: chaos SIGKILLs the run after step 6 (of 8); the
+    relaunch restores step 5's checkpoint — params, opt state, data
+    cursor, rng seed — replays 6..7, and lands BITWISE on the
+    uninterrupted run's params.  Sensitive to every piece of the resume
+    bundle: a dropped cursor or seed changes the replayed batches."""
+    ref, ref_out = _run_demo(tmp_path, "ck_ref", "ref")
+    assert ref.returncode == 0, ref.stderr[-2000:]
+
+    killed, _ = _run_demo(tmp_path, "ck", "killed", chaos="kill@6")
+    assert killed.returncode in (-9, 137), (
+        killed.returncode, killed.stderr[-2000:]
+    )
+    # sync saves at steps 1, 3, 5 — all durable despite the SIGKILL
+    assert latest_durable_step(tmp_path / "ck") == 5
+
+    resumed, res_out = _run_demo(tmp_path, "ck", "resumed")
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    assert "FT-DEMO start=6" in resumed.stdout, resumed.stdout
+
+    a, b = np.load(ref_out), np.load(res_out)
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_sigterm_drains_the_inflight_checkpoint(tmp_path):
+    """Lifecycle satellite: with ASYNC saves, SIGTERM at step 5 arrives
+    while step 3's save may still be in flight.  The flight recorder's
+    shutdown chain runs AutoSaver.close() — the bounded barrier — so
+    the checkpoint commits instead of truncating, the manifest names it
+    durable, and the flight dump records both the preemption and the
+    durable step."""
+    r, _ = _run_demo(
+        tmp_path, "ck", "sigterm", chaos="sigterm@5", sync=False
+    )
+    assert r.returncode in (143, -15), (r.returncode, r.stderr[-2000:])
+    man = read_manifest(tmp_path / "ck")
+    assert man is not None
+    assert man["last_durable_step"] == 3
+    assert latest_durable_step(tmp_path / "ck") == 3
+    fl = json.loads((tmp_path / "run_sigterm" / "flight.json").read_text())
+    assert fl["reason"] == "sigterm"
+    assert fl["meta"]["ckpt_last_durable_step"] == 3
+    assert fl["counts"].get("chaos") == 1
+
+
+# ------------------------------------------------------- cross-mesh restore
+
+
+def test_reshard_refit_and_truncation_guard():
+    true = np.arange(1, 38, dtype=np.float32)  # 37 nonzero elements
+    saved = np.zeros(40, np.float32)
+    saved[:37] = true
+    saved = saved.reshape(8, 5)  # the n=8 shard layout (3 pad zeros)
+    out = reshard_leaf(saved, jnp.zeros((4, 10), jnp.float32), "w")
+    flat = np.asarray(out).reshape(-1)
+    np.testing.assert_array_equal(flat[:37], true)
+    assert flat[37:].sum() == 0
+    # growing back onto the larger mesh round-trips exactly
+    back = reshard_leaf(np.asarray(out), jnp.zeros((8, 5)), "w")
+    np.testing.assert_array_equal(np.asarray(back), saved)
+    # layer-stacked [L, n, k]: per-layer refit
+    stacked = np.stack([saved, 2 * saved])
+    out3 = reshard_leaf(stacked, jnp.zeros((2, 4, 10)), "blocks")
+    np.testing.assert_array_equal(
+        np.asarray(out3)[1].reshape(-1)[:37], 2 * true
+    )
+    # a template too small for the true data must refuse, loudly
+    with pytest.raises(ValueError, match="nonzero"):
+        reshard_leaf(saved, jnp.zeros((2, 10)), "w")  # 20 slots < 37
+    with pytest.raises(ValueError, match="cannot reshard"):
+        reshard_leaf(saved, jnp.zeros((40,)), "w")  # rank change
+    out_t = reshard_state(
+        {"a": saved, "c": np.int64(5)},
+        {"a": jnp.zeros((4, 10)), "c": np.int64(0)},
+    )
+    assert int(out_t["c"]) == 5
+    assert np.asarray(out_t["a"]).shape == (4, 10)
+
+
+def test_cross_mesh_zero3_restore_8_to_4(devices8, tmp_path):
+    """ZeRO-3 state saved on an 8-way mesh restores onto the surviving
+    4-way mesh via the template-sharding path and trains on: the
+    resumed trajectory is equivalent (suite tolerance) to the
+    uninterrupted 8-way run — ZeRO's math is mesh-size-independent, so
+    any divergence is a reshard bug.  The resumed step's collective
+    signature is re-pinned through the compile analytics."""
+    from ddl25spring_tpu.obs import xla_analytics as xa
+    from ddl25spring_tpu.parallel import bucketing, zero
+
+    k0 = jax.random.PRNGKey(0)
+    params = {
+        "w1": jax.random.normal(jax.random.fold_in(k0, 0), (12, 20)) * 0.1,
+        "b1": jnp.zeros((20,)),
+        "w2": jax.random.normal(jax.random.fold_in(k0, 1), (20, 4)) * 0.1,
+    }
+
+    def loss_fn(p, batch, key):
+        del key
+        x, yb = batch
+        return jnp.mean((jnp.tanh(x @ p["w1"] + p["b1"]) @ p["w2"] - yb) ** 2)
+
+    tx = optax.adam(1e-2)
+    mesh8 = make_mesh(devices8, data=8)
+    mesh4 = make_mesh(devices8[:4], data=4)
+    step8 = zero.make_zero_dp_train_step(
+        loss_fn, tx, mesh8, params, per_shard_rng=False
+    )
+    step4 = zero.make_zero_dp_train_step(
+        loss_fn, tx, mesh4, params, per_shard_rng=False
+    )
+    key = jax.random.PRNGKey(1)
+    batches = [
+        (
+            jax.random.normal(jax.random.fold_in(k0, 10 + i), (16, 12)),
+            jax.random.normal(jax.random.fold_in(k0, 20 + i), (16, 4)),
+        )
+        for i in range(4)
+    ]
+
+    # uninterrupted: 4 steps on the 8-way mesh
+    s_ref = zero.zero_shard_params(params, mesh8)
+    o_ref = tx.init(s_ref)
+    for b in batches:
+        s_ref, o_ref, _ = step8(s_ref, o_ref, b, key)
+    p_ref = zero.zero_unshard_params(s_ref, params)
+
+    # interrupted: 2 steps on 8 devices, autosaved, then "the pod
+    # shrinks" — restore on 4 and run the remaining 2 steps
+    saver = AutoSaver(tmp_path / "ck", save_every=1, async_save=False)
+    s, o = zero.zero_shard_params(params, mesh8), None
+    o = tx.init(s)
+    for i, b in enumerate(batches[:2]):
+        s, o, _ = step8(s, o, b, key)
+        assert saver.maybe_save(
+            i, resume_bundle(s, o, data_cursor=i + 1, rng_seed=0)
+        )
+    saver.close()
+
+    saver2 = AutoSaver(tmp_path / "ck", save_every=1)
+    tmpl = zero.zero_resume_template(params, tx, mesh4)
+    state, nxt = saver2.restore_or_init(resume_bundle(
+        tmpl["params"], tmpl["opt_state"], data_cursor=0, rng_seed=0
+    ))
+    assert nxt == 2
+    assert int(state["data_cursor"]) == 2  # the cursor crossed meshes too
+    s4, o4 = state["params"], state["opt_state"]
+    w1 = s4["w1"]
+    assert w1.shape[0] == 4  # re-sharded [8, k] -> [4, k']
+    assert (
+        w1.sharding.spec == jax.tree.leaves(tmpl["params"])[0].sharding.spec
+    )
+    for b in batches[2:]:
+        s4, o4, _ = step4(s4, o4, b, key)
+    saver2.close()
+    p_res = zero.zero_unshard_params(s4, params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        p_res, p_ref,
+    )
+
+    # re-pin the RESUMED step's collective signature (the acceptance
+    # contract: cross-mesh restore must not change what the compiled
+    # step launches) — same expected shape as zero.describe(stage=3)
+    n = 4
+    padded = sum(
+        n * (-(-int(np.prod(l.shape) or 1) // n)) * 4
+        for l in jax.tree.leaves(params)
+    )
+    launches = zero._row_plan(
+        params, n, bucketing.DEFAULT_BUCKET_BYTES
+    ).n_buckets
+    compiled = step4.lower(s4, o4, batches[-1], key).compile()
+    rep = xa.analyze_compiled(compiled, mesh4)
+    expected = {
+        "scalar_bytes": 64,
+        "all-gather": {
+            "min_bytes": padded, "max_bytes": 2 * padded + 256,
+            "axes": ["data"],
+            "min_count": launches, "max_count": 2 * launches,
+        },
+        "reduce-scatter": {
+            "min_bytes": padded // n, "max_bytes": padded // n + 256,
+            "axes": ["data"],
+            "min_count": launches, "max_count": launches,
+        },
+        "all-reduce": {"max_bytes": 64},
+        "forbidden": ["collective-permute", "all-to-all"],
+    }
+    assert xa.check_signature(rep, expected) == []

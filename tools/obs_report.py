@@ -5,7 +5,12 @@
 
 The run directory comes from any obs-instrumented driver — e.g.
 ``python bench.py --smoke`` (CPU) or ``python bench.py --obs-dir DIR``
-(TPU).  Everything reported derives from host-side artifacts
+(TPU).  Besides the perf table, the report renders a "health" section
+from ``flight.json`` and a "recovery" section from the flight meta +
+the autosave ``ckpt/manifest.json`` (last durable step, resume count,
+steps replayed, saves the poisoned-checkpoint gate refused) — so a
+post-mortem answers "what survived" as well as "what died".
+Everything reported derives from host-side artifacts
 (``metrics.jsonl``, ``counters.json``, ``trace.json``); no
 ``jax.profiler`` capture is involved anywhere on this path, so it works
 on tunneled TPU transports where device tracing hangs (RESULTS §6a).
