@@ -291,3 +291,47 @@ def summarize(findings: list[Finding | dict]) -> dict[str, Any]:
         "worst": worst_severity(f["severity"] for f in unwaived),
         "by_rule": by_rule,
     }
+
+
+def attach_measured_costs(
+    findings: list[dict], perf_record: dict[str, Any]
+) -> int:
+    """Cross-reference a perfscope record (:mod:`ddl25spring_tpu.obs.
+    perfscope`) onto H001 findings, in place.
+
+    H001 says "this sync collective leaves overlap on the table" — a
+    judgment with no price tag until a measurement exists.  Each H001
+    finding whose HLO op name appears in the record's micro-cost table
+    gains ``finding["measured"]`` = the standalone wall cost of that
+    very collective on this host, plus the strategy-level measured
+    exposed-comms time and overlap efficiency; findings from a
+    *different* compilation of the same workload (op names don't match,
+    e.g. the bench parent's fake-mesh report vs the child's live run)
+    still gain the strategy-level context.  Only dict findings are
+    annotated (``Finding.to_dict()`` upstream).  Returns the number of
+    findings annotated.
+    """
+    micro_by_op = {
+        m["op"]: m
+        for m in perf_record.get("micro") or []
+        if m.get("op")
+    }
+    exposed = perf_record.get("exposed_comms_s")
+    if exposed is None and perf_record.get("exposed_comms_ms") is not None:
+        exposed = perf_record["exposed_comms_ms"] / 1e3
+    eff = perf_record.get("overlap_eff")
+    n = 0
+    for f in findings:
+        if not isinstance(f, dict) or f.get("rule") != "H001":
+            continue
+        meas: dict[str, Any] = {
+            "exposed_comms_s": exposed,
+            "overlap_eff": eff,
+        }
+        m = micro_by_op.get(f.get("op"))
+        if m and m.get("t_s") is not None:
+            meas["t_s_per_exec"] = m["t_s"]
+            meas["t_total_s"] = m.get("t_total_s")
+        f["measured"] = meas
+        n += 1
+    return n

@@ -17,7 +17,13 @@ being usable:
   programs via ``jax.debug.callback`` (MoE aux/load stats, pipeline tick
   cadence, ZeRO collective bytes);
 - ``tools/obs_report.py`` — folds a run directory into a summary table
-  (steps/sec p50/p95, MFU, bubble fraction, h2d bandwidth).
+  (steps/sec p50/p95, MFU, bubble fraction, h2d bandwidth);
+- :mod:`~ddl25spring_tpu.obs.perfscope` — steady-state measurement
+  harness (imported on demand, not re-exported here): barriered step
+  wall p50/p95, a one-device compute-only counterfactual, standalone
+  micro-costs per collective-inventory site, measured MFU against the
+  calibrated chip peak, and the cross-run regression ledger
+  (``runs/perf_ledger.jsonl`` + ``tools/perf_report.py --check``).
 
 Runtime health (the operable half — the compile-time analytics'
 runtime counterpart):

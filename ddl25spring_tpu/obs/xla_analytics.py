@@ -401,7 +401,8 @@ def _wire_bytes(kind: str, result_bytes: int, group_size: int | None) -> int:
 def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
     """Extract every collective op from optimized-HLO text.
 
-    Returns one record per op *site*: ``{kind, result_bytes, count``
+    Returns one record per op *site*: ``{kind, result_bytes, dtype``
+    (primary element type of the result), ``count``
     (executions per call, loop trip counts folded in), ``trip_known,
     axes, group_size, wire_bytes`` (per execution), ``source, name,
     computation, operands, pairs, async}``.  ``async`` is True for
@@ -426,6 +427,11 @@ def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
             kind = cm.group(1)
             type_str = line.split("=", 1)[1].split(cm.group(0), 1)[0]
             result_bytes = _shape_bytes(type_str)
+            # primary element dtype of the result — what a standalone
+            # re-synthesis of this op must move (obs/perfscope.py's
+            # measured comms cost model keys on it)
+            dm = _SHAPE_RE.search(type_str)
+            dtype = dm.group(1) if dm and dm.group(1) in _DTYPE_BYTES else None
             groups = _parse_groups(line)
             pairs = _parse_pairs(line)
             axes = None
@@ -445,6 +451,7 @@ def parse_hlo_collectives(hlo_text: str, mesh=None) -> list[dict[str, Any]]:
             out.append({
                 "kind": kind,
                 "result_bytes": result_bytes,
+                "dtype": dtype,
                 "count": m,
                 "trip_known": known.get(comp.name, True),
                 "axes": axes,
@@ -553,24 +560,36 @@ def roofline_projection(
     hbm_bytes: float | None,
     ici_bytes: float,
     chips: list[str] | None = None,
+    specs: dict[str, dict[str, float]] | None = None,
 ) -> dict[str, Any]:
     """Project one step's time/MFU onto real chip specs from the three
     compile-time resource totals: FLOPs (MXU), bytes accessed (HBM), and
     collective wire bytes (ICI).  The projection assumes no overlap — a
     deliberate upper bound on step time; its ``bound`` field names the
-    roofline the program would sit on."""
+    roofline the program would sit on.  ``specs`` overlays/extends
+    :data:`~ddl25spring_tpu.utils.flops.CHIP_SPECS` (how perfscope
+    injects the runtime-calibrated cpu-host peak, and how
+    ``tools/resnet_roofline.py`` derates a peak by MXU occupancy)."""
     from ddl25spring_tpu.utils.flops import CHIP_SPECS
 
+    table: dict[str, dict[str, float]] = dict(CHIP_SPECS)
+    if specs:
+        table.update(specs)
     out: dict[str, Any] = {}
     if not flops:
         return out
-    for kind in (chips or list(CHIP_SPECS)):
-        spec = CHIP_SPECS.get(kind)
+    for kind in (chips or list(table)):
+        spec = table.get(kind)
         if not spec:
             continue
+        # a peak-only spec (a chip in PEAK_BF16_FLOPS with no full
+        # CHIP_SPECS entry, e.g. v2/v3 via host_peak_spec) still
+        # projects: an unknown bandwidth simply doesn't bound the step
         t_compute = flops / spec["peak_bf16_flops"]
-        t_hbm = (hbm_bytes or 0.0) / spec["hbm_bytes_per_s"]
-        t_ici = ici_bytes / spec["ici_bytes_per_s"]
+        hbm_bw = spec.get("hbm_bytes_per_s")
+        ici_bw = spec.get("ici_bytes_per_s")
+        t_hbm = (hbm_bytes or 0.0) / hbm_bw if hbm_bw else 0.0
+        t_ici = ici_bytes / ici_bw if ici_bw else 0.0
         t_step = max(t_compute, t_hbm, t_ici)
         bound = {t_compute: "compute", t_hbm: "hbm", t_ici: "ici"}[t_step]
         out[kind] = {

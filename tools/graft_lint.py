@@ -52,6 +52,21 @@ def _fmt_finding(f: dict) -> str:
         line += f"\n      fix: {f['fix_hint']}"
     if f.get("waived"):
         line += f"\n      WAIVED: {f['waived_reason']}"
+    m = f.get("measured")
+    if m:
+        # perfscope cross-reference (--perf-ledger): the overlap
+        # complaint priced by the measured cost of the very op it flags
+        bits = []
+        if m.get("t_s_per_exec") is not None:
+            bits.append(f"~{m['t_s_per_exec'] * 1e3:.3f} ms/exec standalone")
+        if m.get("exposed_comms_s") is not None:
+            bits.append(
+                f"strategy exposed-comms {m['exposed_comms_s'] * 1e3:.3f} ms"
+            )
+        if m.get("overlap_eff") is not None:
+            bits.append(f"overlap eff {m['overlap_eff']:.3f}")
+        if bits:
+            line += f"\n      measured: {'; '.join(bits)}"
     return line
 
 
@@ -107,6 +122,11 @@ def main(argv=None) -> int:
                     help="skip the source (AST) pass")
     ap.add_argument("--waivers", default=None, metavar="TOML",
                     help="waiver file (default: analysis/waivers.toml)")
+    ap.add_argument("--perf-ledger", default=None, metavar="JSONL",
+                    help="cross-reference each strategy's latest "
+                         "measured perf record (obs/perfscope ledger) "
+                         "onto its H001 findings, so overlap "
+                         "complaints carry a measured cost")
     ap.add_argument("--root", default=str(_REPO_ROOT),
                     help="repo root for the source pass")
     args = ap.parse_args(argv)
@@ -156,6 +176,28 @@ def main(argv=None) -> int:
                     f.to_dict() for f in apply_waivers(fresh, waivers)
                 ]
             hlo_reports[name] = r
+
+        if args.perf_ledger:
+            from ddl25spring_tpu.analysis.engine import attach_measured_costs
+            from ddl25spring_tpu.obs.perfscope import (
+                host_fingerprint,
+                read_ledger,
+            )
+
+            # the ledger's trend identity is (strategy, mesh, host) —
+            # a record measured on another machine or mesh must not
+            # print its milliseconds onto THIS compile's findings
+            # (HLO op names are stable across compiles, so a
+            # strategy-only match would silently look plausible)
+            here = host_fingerprint()
+            latest: dict = {}
+            for rec in read_ledger(args.perf_ledger):
+                if rec.get("host") == here:
+                    latest[(rec.get("strategy"), str(rec.get("mesh")))] = rec
+            for name, r in hlo_reports.items():
+                rec = latest.get((name, str(r.get("mesh"))))
+                if rec and r.get("findings"):
+                    attach_measured_costs(r["findings"], rec)
 
     if args.format == "json":
         doc = {

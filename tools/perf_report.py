@@ -1,0 +1,233 @@
+"""Render the perf ledger as per-strategy trend tables and gate
+regressions.
+
+    python tools/perf_report.py                       # trend tables
+    python tools/perf_report.py --strategy dp --last 10
+    python tools/perf_report.py --check               # the CI gate
+    python tools/perf_report.py --check --tolerance 1.0   # wide CI band
+    python tools/perf_report.py --json
+
+The ledger (``runs/perf_ledger.jsonl``, written by
+``python -m ddl25spring_tpu.obs.perfscope`` and by ``bench.py``) holds
+one measured perf record per (strategy, mesh, host) measurement: step
+wall p50/p95, compute-only counterfactual, exposed-comms time, overlap
+efficiency, and measured MFU — see ``ddl25spring_tpu/obs/perfscope.py``
+for the semantics.
+
+``--check`` mirrors the ``comms_report``/``graft_lint`` CLI contract:
+exit non-zero when, within any (strategy, mesh, host) key, the LATEST
+record regresses past the tolerance band against the median of up to
+``--window`` prior records on the same key — step time growing by more
+than ``tolerance`` (fractional, default 0.35), or measured MFU falling
+by more than the same fraction.  Keys with a single record pass with a
+"no baseline yet" note (a fresh ledger must not fail CI), and records
+from different hosts never gate each other (fake-CPU wall clocks are
+host-relative by construction).
+
+Pure stdlib — no jax import, so the gate runs anywhere the JSON does.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+from datetime import datetime, timezone
+from pathlib import Path
+
+DEFAULT_LEDGER = "runs/perf_ledger.jsonl"
+DEFAULT_TOLERANCE = 0.35
+DEFAULT_WINDOW = 5
+
+
+def read_ledger(path: str) -> list[dict]:
+    """Parseable perf records in append order (torn lines skipped) —
+    same contract as ``perfscope.read_ledger``, restated here so the
+    gate never imports jax."""
+    out: list[dict] = []
+    p = Path(path)
+    if not p.exists():
+        return out
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("record") == "perf":
+            out.append(rec)
+    return out
+
+
+def ledger_key(rec: dict) -> tuple[str, str, str]:
+    """(strategy, mesh, host): the trend identity.  git sha is the
+    variable under test, so it stays OUT of the key."""
+    mesh = rec.get("mesh")
+    mesh_s = (
+        ",".join(f"{k}={v}" for k, v in mesh.items())
+        if isinstance(mesh, dict) else str(mesh)
+    )
+    return (
+        str(rec.get("strategy")), mesh_s, str(rec.get("host")),
+    )
+
+
+def group_records(records: list[dict]) -> dict[tuple, list[dict]]:
+    groups: dict[tuple, list[dict]] = {}
+    for rec in records:
+        groups.setdefault(ledger_key(rec), []).append(rec)
+    return groups
+
+
+def _median(xs: list[float]) -> float | None:
+    return statistics.median(xs) if xs else None
+
+
+def check_group(
+    recs: list[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    window: int = DEFAULT_WINDOW,
+) -> list[str]:
+    """Regression verdicts for one key: [] = latest within band (or no
+    baseline yet).  The baseline is the MEDIAN over up to ``window``
+    prior records — one noisy historical rep must not move the gate."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    base = recs[:-1][-window:]
+    fails: list[str] = []
+    b_step = _median([
+        r["step_s_p50"] for r in base
+        if isinstance(r.get("step_s_p50"), (int, float))
+    ])
+    l_step = latest.get("step_s_p50")
+    if b_step and isinstance(l_step, (int, float)):
+        if l_step > b_step * (1.0 + tolerance):
+            fails.append(
+                f"step_s_p50 {l_step * 1e3:.3f} ms exceeds the "
+                f"{(1 + tolerance):.2f}x band over the baseline "
+                f"{b_step * 1e3:.3f} ms (median of {len(base)} prior "
+                "record(s))"
+            )
+    b_mfu = _median([
+        r["measured_mfu"] for r in base
+        if isinstance(r.get("measured_mfu"), (int, float))
+    ])
+    l_mfu = latest.get("measured_mfu")
+    if b_mfu and isinstance(l_mfu, (int, float)):
+        if l_mfu < b_mfu * (1.0 - tolerance):
+            fails.append(
+                f"measured_mfu {l_mfu:.5f} fell below the "
+                f"{(1 - tolerance):.2f}x band under the baseline "
+                f"{b_mfu:.5f}"
+            )
+    return fails
+
+
+def _fmt(v, nd=3, scale=1.0, suffix=""):
+    if not isinstance(v, (int, float)):
+        return "n/a"
+    return f"{v * scale:.{nd}f}{suffix}"
+
+
+def format_group(key: tuple, recs: list[dict], last: int) -> str:
+    strategy, mesh_s, host = key
+    chip = recs[-1].get("chip") or "?"
+    lines = [
+        f"strategy {strategy}  mesh({mesh_s})  host {host}  [chip {chip}]"
+    ]
+    cols = (
+        f"  {'when (utc)':<20}{'sha':<9}{'step p50':>11}{'p95':>11}"
+        f"{'compute':>11}{'exposed':>11}{'overlap':>9}{'MFU':>10}"
+        f"{'proj err':>10}"
+    )
+    lines.append(cols)
+    lines.append("  " + "-" * (len(cols) - 2))
+    for rec in recs[-last:]:
+        ts = rec.get("ts")
+        when = (
+            datetime.fromtimestamp(ts, tz=timezone.utc)
+            .strftime("%Y-%m-%d %H:%M:%S")
+            if isinstance(ts, (int, float)) else "?"
+        )
+        sha = (rec.get("git_sha") or "?")[:7]
+        lines.append(
+            f"  {when:<20}{sha:<9}"
+            f"{_fmt(rec.get('step_s_p50'), 3, 1e3, ' ms'):>11}"
+            f"{_fmt(rec.get('step_s_p95'), 3, 1e3, ' ms'):>11}"
+            f"{_fmt(rec.get('compute_s_p50'), 3, 1e3, ' ms'):>11}"
+            f"{_fmt(rec.get('exposed_comms_s'), 3, 1e3, ' ms'):>11}"
+            f"{_fmt(rec.get('overlap_eff'), 3):>9}"
+            f"{_fmt(rec.get('measured_mfu'), 5):>10}"
+            f"{_fmt(rec.get('projection_err'), 2, 100.0, '%'):>10}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER, metavar="JSONL")
+    ap.add_argument("--strategy", default=None,
+                    help="comma-separated strategy filter")
+    ap.add_argument("--last", type=int, default=8,
+                    help="rows per key in the trend table")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="prior records per key the baseline medians over")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="fractional regression band (0.35 = step may "
+                         "grow 35%%, MFU may drop 35%%); CI machines "
+                         "want wide bands (e.g. 1.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the grouped records as JSON")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero when any key's latest record "
+                         "regresses past the band (the CI perf gate)")
+    args = ap.parse_args(argv)
+
+    records = read_ledger(args.ledger)
+    if not records:
+        print(f"no perf records in {args.ledger} (run "
+              "python -m ddl25spring_tpu.obs.perfscope, or bench.py, "
+              "to populate it)", file=sys.stderr)
+        return 2 if args.check else 0
+    if args.strategy:
+        wanted = {s.strip() for s in args.strategy.split(",") if s.strip()}
+        records = [r for r in records if r.get("strategy") in wanted]
+
+    groups = group_records(records)
+    if args.json:
+        print(json.dumps(
+            {"|".join(k): v for k, v in groups.items()},
+            indent=1, default=str,
+        ))
+    else:
+        print(f"perf ledger: {args.ledger}  ({len(records)} record(s), "
+              f"{len(groups)} key(s))\n")
+        print("\n\n".join(
+            format_group(k, v, args.last) for k, v in groups.items()
+        ))
+
+    if args.check:
+        bad = 0
+        for key, recs in groups.items():
+            label = f"{key[0]} mesh({key[1]})"
+            if len(recs) < 2:
+                print(f"CHECK NOTE {label}: no baseline yet "
+                      "(single record)", file=sys.stderr)
+                continue
+            for fail in check_group(recs, args.tolerance, args.window):
+                print(f"CHECK FAIL {label}: {fail}", file=sys.stderr)
+                bad += 1
+        if bad:
+            return 1
+        print(f"\nperf check OK: {len(groups)} key(s) within the "
+              f"{args.tolerance:.2f} tolerance band", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

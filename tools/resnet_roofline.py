@@ -16,8 +16,14 @@ the ResNet-18 CIFAR variant, compute
   read+write, bwd read of saved activations + cotangents, GroupNorm's
   extra normalize pass);
 
-and take per-layer time = max(compute_bound, bandwidth_bound).  The sum is
-the best achievable step time for THIS architecture at THIS batch — the
+and take per-layer time = max(compute_bound, bandwidth_bound) — which is
+exactly the shared roofline the compile-time analytics project whole
+programs onto, so each layer rides
+``xla_analytics.roofline_projection`` with the chip's peak derated by
+its MXU occupancy.  Chip numbers come from the one
+``utils/flops.CHIP_SPECS`` table (nothing duplicated here; a drift test
+in ``tests/test_flops_tools.py`` pins the fold).  The sum is the best
+achievable step time for THIS architecture at THIS batch — the
 structural ceiling — to compare against the measured step.
 
 Run: ``python tools/resnet_roofline.py [--batch 1024]``.  Pure math, no
@@ -27,10 +33,37 @@ accelerator needed.
 from __future__ import annotations
 
 import argparse
+import sys
+from pathlib import Path
 
-PEAK_BF16 = 197e12       # v5e MXU peak FLOP/s
-HBM_BW = 819e9           # v5e HBM GB/s
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ddl25spring_tpu.obs.xla_analytics import roofline_projection  # noqa: E402
+from ddl25spring_tpu.utils.flops import CHIP_SPECS  # noqa: E402
+
+CHIP = "TPU v5e"
+# module constants kept as *views* of the shared spec table (the drift
+# test asserts they are the same object's numbers, not fresh literals)
+PEAK_BF16 = CHIP_SPECS[CHIP]["peak_bf16_flops"]
+HBM_BW = CHIP_SPECS[CHIP]["hbm_bytes_per_s"]
 MXU_LANE = 128           # systolic array width (contraction + out tiles)
+
+# (name, H, W, Cin, Cout, k, stride, count) — ResNet-18 CIFAR variant
+# (ddl25spring_tpu/models/resnet.py block_plan): stem + 4 groups of 2
+# blocks; 1x1 projections at each stride-2 group entry
+LAYERS = [
+    ("stem 3x3/1", 32, 32, 3, 64, 3, 1, 1),
+    ("g1 3x3", 32, 32, 64, 64, 3, 1, 4),
+    ("g2 entry 3x3/2", 32, 32, 64, 128, 3, 2, 1),
+    ("g2 1x1/2 proj", 32, 32, 64, 128, 1, 2, 1),
+    ("g2 3x3", 16, 16, 128, 128, 3, 1, 3),
+    ("g3 entry 3x3/2", 16, 16, 128, 256, 3, 2, 1),
+    ("g3 1x1/2 proj", 16, 16, 128, 256, 1, 2, 1),
+    ("g3 3x3", 8, 8, 256, 256, 3, 1, 3),
+    ("g4 entry 3x3/2", 8, 8, 256, 512, 3, 2, 1),
+    ("g4 1x1/2 proj", 8, 8, 256, 512, 1, 2, 1),
+    ("g4 3x3", 4, 4, 512, 512, 3, 1, 3),
+]
 
 
 def ceil_to(x: int, m: int) -> int:
@@ -51,42 +84,50 @@ def conv_cost(B, H, W, Cin, Cout, k, stride, bytes_per=2):
     return flops, eff, bytes_
 
 
+def layer_rooflines(batch: int, chip: str = CHIP) -> list[dict]:
+    """Per-layer roofline rows through the shared projection: each conv
+    is one ``roofline_projection`` call with the chip's peak derated by
+    the layer's MXU occupancy (fwd+bwd = 3x fwd for both FLOPs and
+    traffic, as before the fold)."""
+    spec = CHIP_SPECS[chip]
+    rows = []
+    for name, H, W, Cin, Cout, k, s, cnt in LAYERS:
+        f, eff, by = conv_cost(batch, H, W, Cin, Cout, k, s)
+        proj = roofline_projection(
+            3 * f, 3 * by, 0.0, chips=[chip],
+            specs={chip: {**spec, "peak_bf16_flops":
+                          spec["peak_bf16_flops"] * eff}},
+        )[chip]
+        rows.append({
+            "name": name,
+            "count": cnt,
+            "flops_fwd": f,
+            "mxu_eff": eff,
+            "bytes_fwd": by,
+            "t_comp_s": proj["t_compute_s"],
+            "t_bw_s": proj["t_hbm_s"],
+            "t_s": proj["projected_step_s"] * cnt,
+            "bound": proj["bound"],
+        })
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batch", type=int, default=1024)
     args = ap.parse_args(argv)
     B = args.batch
 
-    # (name, H, W, Cin, Cout, k, stride, count) — ResNet-18 CIFAR variant
-    # (ddl25spring_tpu/models/resnet.py block_plan): stem + 4 groups of 2
-    # blocks; 1x1 projections at each stride-2 group entry
-    layers = [
-        ("stem 3x3/1", 32, 32, 3, 64, 3, 1, 1),
-        ("g1 3x3", 32, 32, 64, 64, 3, 1, 4),
-        ("g2 entry 3x3/2", 32, 32, 64, 128, 3, 2, 1),
-        ("g2 1x1/2 proj", 32, 32, 64, 128, 1, 2, 1),
-        ("g2 3x3", 16, 16, 128, 128, 3, 1, 3),
-        ("g3 entry 3x3/2", 16, 16, 128, 256, 3, 2, 1),
-        ("g3 1x1/2 proj", 16, 16, 128, 256, 1, 2, 1),
-        ("g3 3x3", 8, 8, 256, 256, 3, 1, 3),
-        ("g4 entry 3x3/2", 8, 8, 256, 512, 3, 2, 1),
-        ("g4 1x1/2 proj", 8, 8, 256, 512, 1, 2, 1),
-        ("g4 3x3", 4, 4, 512, 512, 3, 1, 3),
-    ]
-
     print(f"{'layer':18s} {'GF(fwd)':>8s} {'MXU eff':>8s} "
           f"{'t_comp':>8s} {'t_bw':>8s} {'t(ms,f+b)':>9s}")
-    tot_t = tot_f = 0.0
-    for name, H, W, Cin, Cout, k, s, cnt in layers:
-        f, eff, by = conv_cost(B, H, W, Cin, Cout, k, s)
-        # fwd + bwd(dgrad+wgrad) = 3x conv flops; traffic ~3x fwd too
-        t_comp = 3 * f / (PEAK_BF16 * eff)
-        t_bw = 3 * by / HBM_BW
-        t = max(t_comp, t_bw) * cnt
-        tot_t += t
-        tot_f += 3 * f * cnt
-        print(f"{name:18s} {f/1e9:8.1f} {eff*100:7.0f}% "
-              f"{t_comp*1e3:8.2f} {t_bw*1e3:8.2f} {t*1e3:9.2f}")
+    rows = layer_rooflines(B)
+    tot_t = sum(r["t_s"] for r in rows)
+    tot_f = sum(3 * r["flops_fwd"] * r["count"] for r in rows)
+    for r in rows:
+        print(f"{r['name']:18s} {r['flops_fwd'] / 1e9:8.1f} "
+              f"{r['mxu_eff'] * 100:7.0f}% "
+              f"{r['t_comp_s'] * 1e3:8.2f} {r['t_bw_s'] * 1e3:8.2f} "
+              f"{r['t_s'] * 1e3:9.2f}")
 
     # GroupNorm + relu + residual adds: elementwise/reduction passes over
     # the activation footprint, bandwidth-bound.  How many full passes
@@ -96,7 +137,7 @@ def main(argv=None):
     # force extra sweeps.  Report both ends of the range.
     act_bytes = 2 * B * sum(
         (H // s) * (W // s) * Cout * cnt
-        for _, H, W, _, Cout, _, s, cnt in layers
+        for _, H, W, _, Cout, _, s, cnt in LAYERS
     )
     opt_bytes = 2 * 11.2e6 * 3 * 4  # params+grad+momentum fp32 r/w
     t_opt = opt_bytes / HBM_BW
