@@ -532,6 +532,18 @@ def main(argv=None) -> None:
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--microbatches", type=int, default=2)
+    ap.add_argument("--stages", type=int, default=0, metavar="S",
+                    help="force the pipeline stage count (0 = auto: "
+                         "2 stages when >= 2 chips, else pure DP; "
+                         "--stages 1 forces pure DP on any chip count "
+                         "— how the perf ledger gets multi-chip "
+                         "bench-dp records)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="backward-overlapped grad-bucket collectives "
+                         "(parallel/dp.py overlap mode; implies pure "
+                         "DP): the BENCH line and perf-ledger records "
+                         "carry layout dp-overlap so before/after "
+                         "measurements never mix")
     ap.add_argument("--scan-steps", type=int, default=0, metavar="K",
                     help="train steps fused per dispatch in the primary "
                          "mode (0 = auto: largest divisor of "
@@ -753,8 +765,18 @@ def main(argv=None) -> None:
         obs.counters.reset()
 
     n = len(devices)
-    dp, S = (n // 2, 2) if n >= 2 else (1, 1)
-    M = args.microbatches if S == 2 else 1
+    if args.stages:
+        S = args.stages
+        dp = max(n // S, 1)
+    elif args.overlap:
+        # overlap restructures the DP gradient path; pin the layout
+        dp, S = n, 1
+    else:
+        dp, S = (n // 2, 2) if n >= 2 else (1, 1)
+    # any pipelined layout takes the microbatch arg (S was only ever 1
+    # or 2 before --stages existed; an S=3/4 run must not silently
+    # degrade to the full-bubble M=1 schedule)
+    M = args.microbatches if S >= 2 else 1
     batch = (args.per_chip_batch * dp * S) // (dp * M) * (dp * M)
 
     # DDL25_BENCH_NTRAIN: shrink the HBM dataset for CPU smoke runs of the
@@ -772,12 +794,12 @@ def main(argv=None) -> None:
     with obs.span("build_step", scan_steps=K):
         if K > 1:
             multi, step, params, opt_state, meta = build_resnet_scan_step(
-                devices, dp, S, M, batch, K, ds.n
+                devices, dp, S, M, batch, K, ds.n, overlap=args.overlap
             )
         else:
             multi = None
             step, params, opt_state, meta = build_resnet_step(
-                devices, dp, S, M, batch
+                devices, dp, S, M, batch, overlap=args.overlap
             )
     n_chips = meta["n_chips"]
     flight.annotate(
@@ -1152,6 +1174,9 @@ def main(argv=None) -> None:
         scan_steps=K,
         peak_tflops_per_chip=peak / 1e12 if peak else None,
         h2d_mib_per_s=round(h2d_mib_s, 1),
+        # the effective grad-bucket threshold (DDL25_BUCKET_BYTES-aware)
+        # so sweep results compare like-for-like across runs
+        bucket_bytes=meta.get("bucket_bytes"),
         telemetry=telemetry,
         secondary=single_line + [
             {

@@ -25,6 +25,7 @@ import optax
 from ddl25spring_tpu.data.native_loader import normalize_on_device
 from ddl25spring_tpu.models.resnet import ResNet18, make_resnet_stages
 from ddl25spring_tpu.ops.losses import cross_entropy_logits
+from ddl25spring_tpu.parallel import bucketing
 from ddl25spring_tpu.parallel.bucketing import donate_argnums
 from ddl25spring_tpu.parallel.dp import make_dp_train_step
 from ddl25spring_tpu.parallel.het_pipeline import make_het_pipeline_train_step
@@ -44,6 +45,7 @@ def build_resnet_step(
     instrument: bool | None = None,
     donate: bool | None = None,
     sentinel: bool | None = None,
+    overlap: bool = False,
 ):
     """Build the north-star train step on ``devices[: dp * S]``.
 
@@ -70,9 +72,22 @@ def build_resnet_step(
     flags, update ratio) with policy log/halt/skip on violation
     (:mod:`ddl25spring_tpu.obs.sentinels`; None = follow
     ``DDL25_SENTINELS`` at build time; HLO-identical when disabled).
+
+    ``overlap`` (pure-DP layouts only, ``S == 1``): the grad-bucket
+    all-reduces are emitted inside the backward in backward-readiness
+    bucket order instead of after the full grad tree
+    (:func:`ddl25spring_tpu.parallel.dp.make_dp_train_step`'s overlap
+    mode — the graft-lint H001 restructure).  The layout string becomes
+    ``"dp-overlap"`` so BENCH lines and perf-ledger records name the
+    variant they measured.  Bitwise-equal to sync DP (pinned).
     """
     if S not in (1, 2, 3, 4):
         raise ValueError(f"resnet pipeline supports S in (1, 2, 3, 4), got {S}")
+    if overlap and S != 1:
+        raise ValueError(
+            "overlap applies to the pure-DP layout (S == 1); the DPxPP "
+            "het pipeline owns its own gradient reduction"
+        )
     n_used = dp * S
     M = num_microbatches if S >= 2 else 1
     if batch % (dp * M):
@@ -127,7 +142,7 @@ def build_resnet_step(
 
         inner = make_dp_train_step(
             loss_fn, tx, mesh, per_shard_rng=False, instrument=instrument,
-            sentinel=sentinel,
+            sentinel=sentinel, overlap=overlap,
         )
         key = jax.random.PRNGKey(1)
 
@@ -136,7 +151,7 @@ def build_resnet_step(
             x = normalize_on_device(raw[0], dtype)
             return inner(params, opt_state, (x, raw[1]), key)
 
-        layout = "dp"
+        layout = "dp-overlap" if overlap else "dp"
         topo = f"mesh(data={dp})"
 
     opt_state = tx.init(params)
@@ -149,6 +164,15 @@ def build_resnet_step(
         "mesh": mesh,
         "num_stages": S,
         "num_microbatches": M,
+        # the effective grad-bucket threshold (DDL25_BUCKET_BYTES-aware)
+        # rides every BENCH line / perf-ledger record so sweep results
+        # stay comparable across runs; the DPxPP pipeline owns its own
+        # reduction and carries None
+        "bucket_bytes": (
+            bucketing.resolve_bucket_bytes(bucketing.AUTO)
+            if S == 1 else None
+        ),
+        "overlap": overlap,
     }
     return step, params, opt_state, meta
 
@@ -166,6 +190,7 @@ def build_resnet_scan_step(
     instrument: bool | None = None,
     donate: bool | None = None,
     sentinel: bool | None = None,
+    overlap: bool = False,
 ):
     """K train steps per dispatch: the on-device input+train loop.
 
@@ -198,6 +223,7 @@ def build_resnet_scan_step(
     step1, params, opt_state, meta = build_resnet_step(
         devices, dp, S, num_microbatches, batch, lr, dtype,
         instrument=instrument, donate=donate, sentinel=sentinel,
+        overlap=overlap,
     )
     K = scan_steps
 

@@ -35,10 +35,14 @@ from typing import Any
 
 COMPILE_REPORT_BASENAME = "compile_report.json"
 
-# strategies cheap enough to compile on every CI run, in report order
+# strategies cheap enough to compile on every CI run, in report order.
+# The overlapped variants (PR 8) ride here so every gate — signature
+# pins, graft-lint, perfscope — applies to them for free; zero1/zero2's
+# overlap twins are registered (xla_analytics.STRATEGIES) but compile
+# only on demand, keeping the tier-1 budget flat.
 DEFAULT_STRATEGIES = (
-    "dp", "zero1", "zero2", "zero3", "zero3-prefetch",
-    "pipeline", "het_pipeline", "tp", "sp", "ep",
+    "dp", "dp-overlap", "zero1", "zero2", "zero3", "zero3-prefetch",
+    "zero3-overlap", "pipeline", "het_pipeline", "tp", "sp", "ep",
 )
 
 

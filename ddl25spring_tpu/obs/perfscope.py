@@ -26,9 +26,12 @@ bench workloads via :func:`measure_bench_step`) it produces a
 
 From these: **exposed-comms time** (step − compute: the traffic the
 schedule failed to hide), **achieved overlap efficiency**
-(1 − exposed/Σmicro — 1.0 means every measured comms second hid behind
-compute), and **measured MFU** (compiled FLOPs / (wall × chip peak ×
-chips)) with the **projection error** against the PR-2 roofline.  On
+(1 − exposed/Σmicro, capped at 1.0 and floor-free — 1.0 means every
+measured comms second hid behind compute; negative means the exposed
+gap exceeds even the un-overlapped comms bill, i.e. non-comms overhead
+such as fake-mesh core contention is leaking into it), and **measured
+MFU** (compiled FLOPs / (wall × chip peak × chips)) with the
+**projection error** against the PR-2 roofline.  On
 the CPU CI image the peak is the runtime-calibrated ``cpu-host``
 pseudo-spec (:func:`ddl25spring_tpu.utils.flops.
 calibrated_host_peak_flops`), so every number is defined — as a
@@ -269,10 +272,19 @@ def time_micro_benches(
     benches: dict[tuple, Any], *, reps: int = 5, warmup: int = 2,
     inner: int = 4,
 ) -> dict[tuple, Any]:
-    """Per-execution p50 seconds for each compiled micro-bench
-    (``inner`` back-to-back launches per timed window amortize the
-    per-dispatch host overhead that would otherwise swamp a
-    microsecond-scale collective)."""
+    """Per-execution seconds for each compiled micro-bench (``inner``
+    back-to-back launches per timed window amortize the per-dispatch
+    host overhead that would otherwise swamp a microsecond-scale
+    collective).
+
+    The estimator is the MIN over the timed windows, not a percentile:
+    the micro table is a *cost model* — what this collective
+    intrinsically costs standalone on this mesh — and the least-
+    contended window is the best estimate of that.  A p50 inherits
+    whatever ambient load the measuring process carries at that moment
+    (measured on the bench path: up to 4x inflation right after the
+    timed phases' memory pressure), which then poisons every
+    ``overlap_eff`` that divides by the micro total."""
     import jax
 
     out: dict[tuple, Any] = {}
@@ -290,7 +302,7 @@ def time_micro_benches(
                 for _ in range(inner):
                     jax.block_until_ready(fn(x))
                 walls.append((time.perf_counter() - t0) / inner)
-            out[key] = _pct(walls, 50)
+            out[key] = min(walls)
         except Exception as e:  # noqa: BLE001 — degrade per bench
             out[key] = f"{type(e).__name__}: {e}"
     return out
@@ -354,8 +366,18 @@ def build_record(
 
     - ``exposed_comms_s = max(0, step_p50 - compute_p50)`` — the comms
       time the schedule failed to hide behind compute;
-    - ``overlap_eff = 1 - exposed / micro_total`` clamped to [0, 1]
-      (None when the program has no costed collectives);
+    - ``overlap_eff = 1 - exposed / micro_total`` capped at 1.0, floor-
+      free (None when the program has no costed collectives): 1.0 means
+      every measured comms second hid behind compute, 0 means exactly
+      the standalone comms bill stayed exposed, and NEGATIVE values
+      mean the exposed gap exceeds even the un-overlapped comms bill —
+      non-comms overhead is leaking into the gap (on fake CPU meshes,
+      the n device programs contending for this host's cores).  A [0, 1]
+      floor would erase exactly that signal: a step whose exposure
+      doubles from 10x to 20x the comms bill would read 0.0 -> 0.0,
+      invisible to the ``--min-overlap-eff`` gate and to before/after
+      comparisons on contended hosts — so the floor is the reader's
+      job, not the record's;
     - ``measured_mfu = flops / (step_p50 * n_chips * peak)`` with the
       chip peak from :func:`~ddl25spring_tpu.utils.flops.
       host_peak_spec` (datasheet on TPU, calibrated on cpu-host);
@@ -378,7 +400,7 @@ def build_record(
     micro_total = sum(costed) if costed else 0.0
     overlap_eff = None
     if exposed is not None and micro_total > 0:
-        overlap_eff = min(1.0, max(0.0, 1.0 - exposed / micro_total))
+        overlap_eff = min(1.0, 1.0 - exposed / micro_total)
 
     kind, spec = host_peak_spec(device)
     peak = (spec or {}).get("peak_bf16_flops")
@@ -494,18 +516,21 @@ def measure_strategy(
     micro_reps: int = 5,
     rounds: int = 1,
     compute_counterfactual: bool = True,
+    **overrides: Any,
 ) -> list[dict[str, Any]]:
     """The full perfscope pass over one registered strategy: compile on
     its fake mesh, time the step, time the 1-device counterfactual,
     micro-cost the collective inventory, derive, and cross-reference
     H001 findings.  Returns ``rounds`` records (every round re-times
     the SAME compiled programs — how the CI job gives the regression
-    gate a baseline without paying compilation twice)."""
+    gate a baseline without paying compilation twice).  ``overrides``
+    forward to the strategy's ``describe()`` (how ``tools/bucket_sweep.
+    py`` re-describes one strategy per ``bucket_bytes`` grid point)."""
     from ddl25spring_tpu.analysis.engine import attach_measured_costs
     from ddl25spring_tpu.obs import xla_analytics as xa
 
     mesh = xa.strategy_mesh(name, mesh_sizes)
-    d = xa.describe_strategy(name, mesh)
+    d = xa.describe_strategy(name, mesh, **overrides)
     compiled = d["fn"].lower(*d["args"]).compile()
     hlo_text = compiled.as_text()
     report = xa.analyze_compiled(
@@ -527,7 +552,7 @@ def measure_strategy(
     if compute_counterfactual:
         try:
             mesh1 = xa.strategy_mesh(name, (1,) * len(mesh.axis_names))
-            d1 = xa.describe_strategy(name, mesh1)
+            d1 = xa.describe_strategy(name, mesh1, **overrides)
             c1 = d1["fn"].lower(*d1["args"]).compile()
         except Exception as e:  # noqa: BLE001 — a strategy that cannot
             # shrink to one device still gets step + micro measurements
@@ -562,6 +587,7 @@ def measure_strategy(
             )
         costs = time_micro_benches(benches, reps=micro_reps)
         micro = micro_site_records(ops, site_keys, costs)
+        meta = d.get("meta") or {}
         rec = build_record(
             strategy=name, mesh_axes=mesh_axes, n_chips=n_chips,
             step=step_stats, compute=compute_stats,
@@ -569,6 +595,14 @@ def measure_strategy(
             flops=report.get("flops"),
             bytes_accessed=report.get("bytes_accessed"),
             wire_bytes=wire_total,
+            # the bucket threshold / overlap mode the strategy compiled
+            # with: the sweep + before/after ledger comparisons key on
+            # these being explicit in every record
+            extra={
+                k: meta[k]
+                for k in ("bucket_bytes", "n_buckets", "overlap")
+                if k in meta
+            },
         )
         # the linter's overlap complaints (H001) gain the measured cost
         # of the very op they flag; the trimmed findings ride the record
@@ -681,7 +715,11 @@ def measure_bench_step(
         bytes_accessed=bytes_accessed,
         wire_bytes=wire_total,
         device=meta.get("device"),
-        extra={"batch": int(meta.get("batch", 0)) or None},
+        extra={
+            "batch": int(meta.get("batch", 0)) or None,
+            "bucket_bytes": meta.get("bucket_bytes"),
+            **({"overlap": True} if meta.get("overlap") else {}),
+        },
     )
     return record, params, opt_state
 

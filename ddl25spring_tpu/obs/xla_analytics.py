@@ -720,6 +720,16 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "module": "ddl25spring_tpu.parallel.dp",
         "axes": ("data",), "default_mesh": (4,),
     },
+    "dp-overlap": {
+        # backward-overlapped gradient buckets: each bucket's all-reduce
+        # is emitted by a per-bucket custom_vjp bwd rule inside the
+        # backward, buckets planned in backward-readiness order
+        # (parallel/bucketing.overlap_wrap) — same signature as dp,
+        # bitwise-equal params, pinned in tests/test_bucketing.py
+        "module": "ddl25spring_tpu.parallel.dp",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"overlap": True},
+    },
     "zero1": {
         "module": "ddl25spring_tpu.parallel.zero",
         "axes": ("data",), "default_mesh": (4,), "kwargs": {"stage": 1},
@@ -739,6 +749,24 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "module": "ddl25spring_tpu.parallel.zero",
         "axes": ("data",), "default_mesh": (4,),
         "kwargs": {"stage": 3, "prefetch": True},
+    },
+    # backward-overlapped ZeRO variants: the gradient collective (stage
+    # 1 all-reduce / stage 2 reduce-scatter / stage 3 bwd reduce-
+    # scatter) fires inside the backward per backward-readiness bucket
+    "zero1-overlap": {
+        "module": "ddl25spring_tpu.parallel.zero",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"stage": 1, "overlap": True},
+    },
+    "zero2-overlap": {
+        "module": "ddl25spring_tpu.parallel.zero",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"stage": 2, "overlap": True},
+    },
+    "zero3-overlap": {
+        "module": "ddl25spring_tpu.parallel.zero",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"stage": 3, "overlap": True},
     },
     "pipeline": {
         "module": "ddl25spring_tpu.parallel.pipeline",

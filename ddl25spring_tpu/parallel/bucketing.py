@@ -32,6 +32,33 @@ ZeRO's row-packed ``[n, k]`` layout buckets with the same plan by
 overriding the per-leaf packed size (``sizes=`` = the padded row length
 ``k``); the gather/scatter plumbing specific to that layout lives in
 :mod:`ddl25spring_tpu.parallel.zero`.
+
+**Overlapped mode (PR 8).**  Post-hoc bucketing still reduces *after*
+``value_and_grad`` returns, i.e. the collectives sit textually after
+the whole backward, and — worse — flatten-order buckets mix early- and
+late-layer leaves, so a bucket's collective cannot start until its
+*earliest* layer's cotangent exists, which is the very END of the
+backward pass.  :func:`overlap_wrap` restructures both facts away:
+params pass through one identity ``custom_vjp`` per bucket *inside the
+differentiated function*, whose bwd rule packs that bucket's cotangents
+and issues the reduction (``pmean``/``psum``/``psum_scatter``) the
+moment they exist; buckets are planned in **backward-readiness order**
+(``order="backward"``: the last layers' leaves fill bucket 0), so
+bucket k's collective depends only on layers >= k and can run while
+layer k-1's backward computes — the compute/comms overlap schedule of
+arXiv:2204.06514 §4.2, expressed as dataflow XLA's latency-hiding
+scheduler can exploit.  Reduced grads come straight out of
+``jax.value_and_grad`` — bitwise-equal to the post-hoc path (psum is
+elementwise; packing commutes with it), pinned in
+``tests/test_bucketing.py``.
+
+The bucket threshold itself is tunable per host: builders default to
+:data:`AUTO`, resolved at BUILD time by :func:`resolve_bucket_bytes`
+from the ``DDL25_BUCKET_BYTES`` env knob (via the sanctioned
+``utils.config`` boundary — rule S101), so a ``tools/bucket_sweep.py``
+recommendation applies without touching code.  ``describe()`` hooks pin
+explicit sizes so compile-time signatures never drift with the
+environment.
 """
 
 from __future__ import annotations
@@ -43,6 +70,36 @@ import jax.numpy as jnp
 from jax import lax
 
 DEFAULT_BUCKET_BYTES = 4 * 1024 * 1024
+
+# builders' bucket_bytes default: resolve DDL25_BUCKET_BYTES at build
+# time (resolve_bucket_bytes); a string sentinel so `None` keeps meaning
+# "per-leaf, no bucketing" as it has since PR 3
+AUTO = "auto"
+
+
+def default_bucket_bytes() -> int | None:
+    """The effective bucket threshold when a builder is handed
+    :data:`AUTO`: ``DDL25_BUCKET_BYTES`` (bytes; ``0`` restores the
+    per-leaf path) or :data:`DEFAULT_BUCKET_BYTES` when unset.  Like
+    :func:`donation_default`, the env read routes through
+    :func:`~ddl25spring_tpu.utils.config.env_int` — the one sanctioned
+    env boundary (rule S101) — and is resolved when the step is BUILT,
+    never at trace time."""
+    from ddl25spring_tpu.utils.config import env_int
+
+    bb = env_int("DDL25_BUCKET_BYTES", DEFAULT_BUCKET_BYTES)
+    return bb if bb > 0 else None
+
+
+def resolve_bucket_bytes(bucket_bytes) -> int | None:
+    """Normalize a builder's ``bucket_bytes`` kwarg: :data:`AUTO` ->
+    :func:`default_bucket_bytes` (the env knob), ``None``/``0`` -> None
+    (per-leaf), anything else -> ``int(bucket_bytes)``."""
+    if bucket_bytes == AUTO:
+        return default_bucket_bytes()
+    if not bucket_bytes:
+        return None
+    return int(bucket_bytes)
 
 
 def donation_default() -> bool:
@@ -153,6 +210,7 @@ def plan_buckets(
     tree,
     bucket_bytes: int | float = DEFAULT_BUCKET_BYTES,
     sizes: list[int] | None = None,
+    order: str = "forward",
 ) -> BucketPlan:
     """Greedy order-preserving packing: walk the leaves in flatten order,
     appending each to the open bucket of its dtype until adding it would
@@ -164,9 +222,20 @@ def plan_buckets(
     ``sizes`` overrides the per-leaf packed element count (ZeRO's padded
     ``k`` rows); default is the leaf's own size.  Only shapes/dtypes are
     read, so ``tree`` may hold tracers.
+
+    ``order="backward"`` walks the leaves in REVERSED flatten order —
+    the bucket composition the overlapped gradient path needs: flatten
+    order tracks the forward pass, so cotangents arrive in reverse, and
+    a bucket must wait for its *earliest* member.  Reverse-walked
+    buckets group leaves that become ready together in the backward
+    (bucket 0 = the last layers, complete first), instead of forward
+    buckets whose first leaf is the last cotangent of the whole pass.
+    Pack/unpack are index-driven, so both orders round-trip identically.
     """
     import numpy as np
 
+    if order not in ("forward", "backward"):
+        raise ValueError(f"order must be 'forward' or 'backward', got {order!r}")
     leaves, treedef = jax.tree.flatten(tree)
     # getattr-first so abstract templates (jax.ShapeDtypeStruct from
     # eval_shape) plan identically to concrete arrays
@@ -182,15 +251,20 @@ def plan_buckets(
             f"sizes has {len(sizes)} entries for {len(leaves)} leaves"
         )
     bucket_bytes = max(int(bucket_bytes), 1)
+    walk = (
+        list(enumerate(zip(dtypes, sizes)))
+        if order == "forward"
+        else list(enumerate(zip(dtypes, sizes)))[::-1]
+    )
     open_by_dtype: dict = {}  # dtype -> (indices, bytes)
     buckets: list[tuple[int, ...]] = []
-    order: list = []  # dtype keys in first-seen order, for determinism
-    for i, (dt, sz) in enumerate(zip(dtypes, sizes)):
+    seen_order: list = []  # dtype keys in first-seen order, for determinism
+    for i, (dt, sz) in walk:
         nbytes = sz * dt.itemsize
         cur = open_by_dtype.get(dt)
         if cur is None:
             open_by_dtype[dt] = ([i], nbytes)
-            order.append(dt)
+            seen_order.append(dt)
             continue
         idxs, used = cur
         if used + nbytes > bucket_bytes and idxs:
@@ -199,7 +273,7 @@ def plan_buckets(
         else:
             idxs.append(i)
             open_by_dtype[dt] = (idxs, used + nbytes)
-    for dt in order:
+    for dt in seen_order:
         idxs, _ = open_by_dtype[dt]
         if idxs:
             buckets.append(tuple(idxs))
@@ -233,3 +307,101 @@ def bucketed_psum(tree, axis: str,
     """Per-bucket ``lax.psum`` of every leaf (see :func:`bucketed_pmean`)."""
     plan = plan_buckets(tree, bucket_bytes)
     return plan.unpack([lax.psum(b, axis) for b in plan.pack(tree)])
+
+
+# ---------------------------------------------------- overlapped backward
+
+
+def overlap_wrap(tree, plan: BucketPlan, reduce_bucket):
+    """Route ``tree`` through one identity ``custom_vjp`` per bucket so
+    each bucket's gradient reduction is issued INSIDE the backward, at
+    the dataflow point where that bucket's cotangents are complete.
+
+    Must be applied to the (device-varying) params *inside the
+    differentiated function* — wrapping outside ``jax.grad``'s scope
+    means the bwd rules never run and the grads come back unreduced.
+    The forward is identity (zero HLO once XLA folds it); the backward
+    of bucket ``b`` receives the bucket's cotangent leaves and returns
+    ``reduce_bucket(cts, b)`` — a tuple of reduced cotangents in the
+    same shapes.  With buckets planned ``order="backward"`` the k-th
+    wrapper's bwd fires while layer k-1's backward still computes, so
+    its collective is schedulable concurrently with the remaining
+    backward — the overlap the sync post-hoc path (:func:`bucketed_
+    pmean` after ``value_and_grad``) structurally forfeits when buckets
+    span distant layers.
+
+    ``reduce_bucket(cts: tuple, b: int) -> tuple`` owns the collective:
+    :func:`flat_bucket_reduce` builds the flat-concat ``pmean``/``psum``
+    closure DP and ZeRO-1 use; ZeRO-2's row-scatter closure lives in
+    :mod:`ddl25spring_tpu.parallel.zero`.
+    """
+    leaves = plan.treedef.flatten_up_to(tree)
+    out = list(leaves)
+    for b, idxs in enumerate(plan.buckets):
+        barrier = _bucket_barrier(reduce_bucket, b)
+        reduced = barrier(tuple(leaves[i] for i in idxs))
+        for i, o in zip(idxs, reduced):
+            out[i] = o
+    return plan.treedef.unflatten(out)
+
+
+def _bucket_barrier(reduce_bucket, b: int):
+    """One bucket's identity-forward / reduce-backward ``custom_vjp``
+    (a factory so the loop in :func:`overlap_wrap` closes over the
+    right bucket index)."""
+
+    @jax.custom_vjp
+    def barrier(group: tuple):
+        return group
+
+    def fwd(group):
+        return group, None
+
+    def bwd(_, cts):
+        return (tuple(reduce_bucket(tuple(cts), b)),)
+
+    barrier.defvjp(fwd, bwd)
+    return barrier
+
+
+def flat_bucket_reduce(plan: BucketPlan, axis, op: str = "pmean"):
+    """The flat-concat bucket reducer for :func:`overlap_wrap`: pack the
+    bucket's cotangents into one 1-D buffer, ``pmean``/``psum`` it over
+    ``axis``, split back.  One collective per bucket, issued in the
+    backward — the same arithmetic per element as :func:`bucketed_pmean`
+    (psum is elementwise; concatenation commutes with it), so the
+    overlapped gradient path is bitwise-equal to the post-hoc one."""
+    if op not in ("pmean", "psum"):
+        raise ValueError(f"op must be 'pmean' or 'psum', got {op!r}")
+    reduce = lax.pmean if op == "pmean" else lax.psum
+
+    def reduce_bucket(cts, b):
+        idxs = plan.buckets[b]
+        buf = (
+            cts[0].reshape(-1) if len(cts) == 1
+            else jnp.concatenate([c.reshape(-1) for c in cts])
+        )
+        buf = reduce(buf, axis)
+        out, off = [], 0
+        for i in idxs:
+            size = plan.sizes[i]
+            out.append(
+                buf[off:off + size]
+                .reshape(plan.shapes[i])
+                .astype(plan.dtypes[i])
+            )
+            off += size
+        return tuple(out)
+
+    return reduce_bucket
+
+
+def overlapped_grad_reduce(tree, axis, bucket_bytes, op: str = "pmean"):
+    """Convenience wrapper: plan ``tree``'s leaves into backward-
+    readiness buckets and :func:`overlap_wrap` them with the flat
+    ``pmean``/``psum`` reducer.  Apply to the device-varying params
+    inside the differentiated function; ``jax.value_and_grad`` then
+    returns already-reduced grads, with one collective per bucket
+    embedded in the backward dataflow."""
+    plan = plan_buckets(tree, bucket_bytes, order="backward")
+    return overlap_wrap(tree, plan, flat_bucket_reduce(plan, axis, op))

@@ -82,9 +82,10 @@ def make_dp_train_step(
     axis: str = "data",
     per_shard_rng: bool = True,
     instrument: bool | None = None,
-    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    bucket_bytes: int | float | None = bucketing.AUTO,
     donate: bool | None = None,
     sentinel: bool | None = None,
+    overlap: bool = False,
 ):
     """Gradient-aggregation DP trainstep over ``mesh[axis]``.
 
@@ -101,14 +102,26 @@ def make_dp_train_step(
     ``tests/test_obs.py``); enabled, the callbacks cost one host transfer
     per step.
 
-    ``bucket_bytes`` (default 4 MiB): launch the gradient all-reduce per
-    flat dtype-homogeneous **bucket** instead of per pytree leaf —
-    O(n_buckets) collective launches instead of O(n_leaves), same bytes
-    on the wire (:mod:`ddl25spring_tpu.parallel.bucketing`).  Bitwise
-    equal to the per-leaf path (``None``/``0`` restores it): psum is
-    elementwise across devices, so packing commutes with it — pinned in
+    ``bucket_bytes`` (default :data:`~ddl25spring_tpu.parallel.
+    bucketing.AUTO` = the ``DDL25_BUCKET_BYTES`` knob, 4 MiB unset):
+    launch the gradient all-reduce per flat dtype-homogeneous
+    **bucket** instead of per pytree leaf — O(n_buckets) collective
+    launches instead of O(n_leaves), same bytes on the wire
+    (:mod:`ddl25spring_tpu.parallel.bucketing`).  Bitwise equal to the
+    per-leaf path (``None``/``0`` restores it): psum is elementwise
+    across devices, so packing commutes with it — pinned in
     ``tests/test_bucketing.py`` and visible in the compile-time
     collective inventory (``tests/test_xla_analytics.py``).
+
+    ``overlap`` (requires bucketing): issue each bucket's all-reduce
+    INSIDE the backward — params route through a per-bucket identity
+    ``custom_vjp`` whose bwd rule reduces that bucket's cotangents the
+    moment they exist, with buckets planned in backward-readiness
+    order (:func:`~ddl25spring_tpu.parallel.bucketing.overlapped_grad_
+    reduce`).  Bucket k's collective then depends only on layers >= k
+    and can overlap layer k-1's backward compute instead of queueing
+    after the full grad tree — the graft-lint H001 restructure.  Still
+    bitwise-equal to the per-leaf path (same pinned oracle).
 
     ``donate`` (default on, see :func:`donate_argnums`): alias the
     params/opt-state inputs to the outputs so the update runs in place —
@@ -128,6 +141,12 @@ def make_dp_train_step(
 
     instr = obs.enabled() if instrument is None else bool(instrument)
     s_on, s_policy = sentinels.resolve(sentinel)
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
+    if overlap and not bucket_bytes:
+        raise ValueError(
+            "overlap=True needs the bucketed path; pass a bucket_bytes "
+            "threshold (or leave the AUTO default)"
+        )
 
     @partial(
         shard_map,
@@ -138,6 +157,20 @@ def make_dp_train_step(
     def loss_and_pmean_grad(params, batch, key):
         if per_shard_rng:
             key = jax.random.fold_in(key, lax.axis_index(axis))
+
+        if overlap:
+            # overlapped path: the per-bucket pmean is emitted by each
+            # bucket's custom_vjp bwd rule, INSIDE the backward dataflow
+            # — value_and_grad returns already-reduced grads, and bucket
+            # k's all-reduce is schedulable against layer k-1's backward
+            lparams = pcast(params, axis, to="varying")
+
+            def reduced_loss(p):
+                p = bucketing.overlapped_grad_reduce(p, axis, bucket_bytes)
+                return loss_fn(p, batch, key)
+
+            loss, grads = jax.value_and_grad(reduced_loss)(lparams)
+            return lax.pmean(loss, axis), grads
 
         if bucket_bytes:
             # bucketed path: take LOCAL grads (params cast axis-varying so
@@ -181,8 +214,8 @@ def make_dp_train_step(
         updates, new_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
         new_params, new_state = sentinels.guard(
-            "dp", (new_params, new_state), loss=loss, grads=grads,
-            params=params, updates=updates,
+            "dp-overlap" if overlap else "dp", (new_params, new_state),
+            loss=loss, grads=grads, params=params, updates=updates,
             fallback=(params, opt_state), enabled=s_on, policy=s_policy,
         )
         return new_params, new_state, loss
@@ -196,6 +229,7 @@ def make_dp_weight_avg_step(
     mesh: Mesh,
     axis: str = "data",
     per_shard_rng: bool = True,
+    bucket_bytes: int | float | None = bucketing.AUTO,
     donate: bool | None = None,
     sentinel: bool | None = None,
 ):
@@ -206,6 +240,16 @@ def make_dp_weight_avg_step(
     :func:`stack_opt_state`).  Params enter and leave replicated (averaged
     every step, i.e. sync_every=1, the reference scripts' cadence).
 
+    ``bucket_bytes`` (default :data:`~ddl25spring_tpu.parallel.
+    bucketing.AUTO`): the weight-sync pmean launches per flat bucket
+    instead of per leaf — the same O(n_buckets) collapse the gradient
+    path got in PR 3, now on this variant's only collective (it had
+    stayed per-leaf).  Bitwise-equal (elementwise pmean commutes with
+    packing); ``None``/``0`` restores per-leaf.  There is no separate
+    ``overlap`` mode here: the weight pmean's operand is the *updated*
+    params, which depend on the entire backward + optimizer by
+    construction — nothing earlier in the step could overlap it.
+
     ``sentinel``: in-step numerics sentinels
     (:mod:`ddl25spring_tpu.obs.sentinels`; cross-shard facts reduced
     over ``axis`` — the grad norm aggregates every replica's local
@@ -214,6 +258,7 @@ def make_dp_weight_avg_step(
     from ddl25spring_tpu.obs import sentinels
 
     s_on, s_policy = sentinels.resolve(sentinel)
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
     n = mesh.shape[axis]
 
     @partial(
@@ -235,7 +280,11 @@ def make_dp_weight_avg_step(
         updates, opt_state = tx.update(grads, opt_state, local_params)
         stepped = optax.apply_updates(local_params, updates)
         # the *intended* all_reduce-of-weights of intro_DP_WA.py:54-67
-        avg_params = lax.pmean(stepped, axis)
+        # (per flat bucket when bucketing — one launch per bucket)
+        avg_params = (
+            bucketing.bucketed_pmean(stepped, axis, bucket_bytes)
+            if bucket_bytes else lax.pmean(stepped, axis)
+        )
         avg_params, opt_state = sentinels.guard(
             "dp-weight-avg", (avg_params, opt_state),
             loss=lax.pmean(loss, axis), grads=grads, params=local_params,
@@ -289,7 +338,13 @@ def _tiny_mlp_workload(n_shards: int):
     return params, loss_fn, batch, param_bytes
 
 
-def describe(mesh: Mesh, axis: str = "data", bucketed: bool = True):
+def describe(
+    mesh: Mesh,
+    axis: str = "data",
+    bucketed: bool = True,
+    overlap: bool = False,
+    bucket_bytes: int | float | None = None,
+):
     """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
     lowerable DP train step + example inputs + the analytic collective
     signature.
@@ -303,16 +358,38 @@ def describe(mesh: Mesh, axis: str = "data", bucketed: bool = True):
     non-scalar all-reduce additionally collapses to ONE site per grad
     bucket, and the step is compiled donated — params+opt state aliased
     in place, pinned via ``memory`` / ``donation`` below.
+
+    ``overlap=True`` describes the strategy ``dp-overlap``: the same
+    signature (identical bytes, bucket-count launch ceiling, data-axis
+    grouping, donation floor) with every bucket's all-reduce emitted by
+    the backward's per-bucket ``custom_vjp`` — the restructure is a
+    scheduling/dataflow change, so any signature drift here means the
+    overlap machinery changed what goes on the wire, not just when.
+
+    ``bucket_bytes`` pins an explicit threshold (the bucket-sweep
+    harness); the default is :data:`~ddl25spring_tpu.parallel.bucketing.
+    DEFAULT_BUCKET_BYTES` — deliberately NOT the env knob, so compile-
+    time signature pins never drift with ambient ``DDL25_BUCKET_BYTES``.
     """
+    if overlap and not bucketed:
+        raise ValueError("overlap describes the bucketed DP path only")
     n = mesh.shape[axis]
     params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
     tx = optax.sgd(0.1)
+    bb = (
+        (bucket_bytes or bucketing.DEFAULT_BUCKET_BYTES) if bucketed
+        else None
+    )
     step = make_dp_train_step(
         loss_fn, tx, mesh, axis=axis, per_shard_rng=False, instrument=False,
-        bucket_bytes=bucketing.DEFAULT_BUCKET_BYTES if bucketed else None,
-        donate=True,
+        bucket_bytes=bb, donate=True, overlap=overlap,
     )
-    n_buckets = bucketing.n_buckets_for(params) if bucketed else None
+    n_buckets = (
+        bucketing.plan_buckets(
+            params, bb, order="backward" if overlap else "forward"
+        ).n_buckets
+        if bucketed else None
+    )
     opt_state = tx.init(params)
     state_bytes = sum(
         jnp.size(l) * jnp.result_type(l).itemsize
@@ -349,6 +426,8 @@ def describe(mesh: Mesh, axis: str = "data", bucketed: bool = True):
             "grad_bytes": param_bytes,
             "n_param_leaves": len(jax.tree.leaves(params)),
             **({"n_buckets": n_buckets} if bucketed else {}),
+            **({"bucket_bytes": bb} if bucketed else {}),
+            **({"overlap": True} if overlap else {}),
         },
         "expected": expected,
     }

@@ -56,16 +56,19 @@ def _leaf_meta(leaf, n: int):
     return size, k
 
 
-def _row_plan(params_template, n: int, bucket_bytes):
+def _row_plan(params_template, n: int, bucket_bytes, order: str = "forward"):
     """Bucket plan over the padded ``[n, k]`` row layout: leaf ``i``
     contributes its ``k_i`` shard-row elements per device (not its raw
     size), so one packed bucket row is exactly what one device holds of
-    the bucket's leaves."""
+    the bucket's leaves.  ``order="backward"`` plans buckets in
+    backward-readiness order (the overlapped variants)."""
     ks = [
         _leaf_meta(leaf, n)[1]
         for leaf in jax.tree.leaves(params_template)
     ]
-    return bucketing.plan_buckets(params_template, bucket_bytes, sizes=ks)
+    return bucketing.plan_buckets(
+        params_template, bucket_bytes, sizes=ks, order=order
+    )
 
 
 def _pack_rows(plan, tree):
@@ -88,6 +91,52 @@ def _split_rows(plan, bufs):
         for i, off in zip(idxs, plan.offsets(b)):
             leaves[i] = bufs[b][:, off:off + plan.sizes[i]]
     return plan.treedef.unflatten(leaves)
+
+
+def _overlap_row_scatter_reduce(plan, n: int, axis: str):
+    """Bucket reducer for :func:`~ddl25spring_tpu.parallel.bucketing.
+    overlap_wrap` on ZeRO-2's row layout: pack the bucket's cotangents
+    into the padded ``[n, K]`` row buffer and ``psum_scatter`` straight
+    into this device's row — the stage-2 collective, emitted inside the
+    backward the moment the bucket's cotangents exist.
+
+    A ``custom_vjp`` bwd must return full-leaf-shaped cotangents, so
+    the scattered ``[1, K]`` row is re-seated at row ``i`` of a zeroed
+    ``[n, K]`` buffer and unpacked; rows != i are zero and the step
+    slices row ``i`` straight back out (the zeros never reach the
+    optimizer).  The padded container is transient bwd-local memory —
+    the same order as the cotangents feeding it — so stage 2 keeps its
+    O(P/n) *persistent* grad state."""
+
+    def reduce_bucket(cts, b):
+        idxs = plan.buckets[b]
+        i = lax.axis_index(axis)
+        rows = []
+        for ct, li in zip(cts, idxs):
+            k = plan.sizes[li]
+            size = int(np.prod(plan.shapes[li])) if plan.shapes[li] else 1
+            rows.append(
+                jnp.pad(ct.reshape(-1), (0, n * k - size)).reshape(n, k)
+            )
+        buf = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+        shard = lax.psum_scatter(
+            buf, axis, scatter_dimension=0, tiled=True
+        ) / n
+        padded = lax.dynamic_update_slice_in_dim(
+            jnp.zeros_like(buf), shard, i, 0
+        )
+        out = []
+        for li, off in zip(idxs, plan.offsets(b)):
+            size = int(np.prod(plan.shapes[li])) if plan.shapes[li] else 1
+            out.append(
+                padded[:, off:off + plan.sizes[li]]
+                .reshape(-1)[:size]
+                .reshape(plan.shapes[li])
+                .astype(plan.dtypes[li])
+            )
+        return tuple(out)
+
+    return reduce_bucket
 
 
 def _gather_bucketed(plan, shards, axis: str, n: int):
@@ -163,9 +212,10 @@ def make_zero_dp_train_step(
     per_shard_rng: bool = True,
     num_microbatches: int = 1,
     instrument: bool | None = None,
-    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    bucket_bytes: int | float | None = bucketing.AUTO,
     donate: bool | None = None,
     sentinel: bool | None = None,
+    overlap: bool = False,
 ):
     """Build the fully-sharded trainstep.
 
@@ -212,6 +262,19 @@ def make_zero_dp_train_step(
     donate_argnums`): alias the param-shard and opt-state inputs to the
     outputs — the sharded update runs in place.
 
+    ``overlap`` (requires bucketing): ZeRO-3's backward reduce-scatter
+    is *already* emitted inside the backward — it is the transpose of
+    the forward's in-function all-gather, so XLA places each bucket's
+    scatter exactly where that bucket's cotangents complete.  What the
+    sync plan forfeits is bucket COMPOSITION: flatten-order buckets mix
+    early and late layers, so a scatter still waits for its earliest
+    member — the very end of the backward.  ``overlap=True`` plans the
+    row buckets in backward-readiness order (reversed flatten: bucket 0
+    = the last layers, ready first), letting each scatter fire while
+    earlier layers' backward still computes.  Identical bytes, launch
+    count, and numerics (the scatter sums elementwise regardless of
+    packing order — pinned in ``tests/test_bucketing.py``).
+
     ``sentinel`` (None = follow ``DDL25_SENTINELS`` at build time):
     in-step numerics sentinels over the SHARDED gradient tree — the
     square-norm and non-finite flags psum/pmax over ``axis`` before
@@ -226,6 +289,12 @@ def make_zero_dp_train_step(
 
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
+    if overlap and not bucket_bytes:
+        raise ValueError(
+            "overlap=True needs the bucketed path; pass a bucket_bytes "
+            "threshold (or leave the AUTO default)"
+        )
     n = mesh.shape[axis]
     shapes = jax.tree.map(lambda l: jnp.shape(l), params_template)
     dtypes = jax.tree.map(lambda l: jnp.result_type(l), params_template)
@@ -245,7 +314,11 @@ def make_zero_dp_train_step(
         obs.counters.add_static("zero.reduce_scatter_bytes_per_step", wire)
         obs.counters.add_static("zero.params_bytes_gathered", gathered)
 
-    plan = _row_plan(params_template, n, bucket_bytes) if bucket_bytes else None
+    plan = (
+        _row_plan(params_template, n, bucket_bytes,
+                  order="backward" if overlap else "forward")
+        if bucket_bytes else None
+    )
 
     def gather_full(shards):
         if plan is not None:
@@ -334,7 +407,8 @@ def make_zero_dp_train_step(
             updates, new_state = tx.update(gshards, ostate, pshards)
             new_shards = optax.apply_updates(pshards, updates)
             new_shards, new_state = _sentinels.guard(
-                "zero3", (new_shards, new_state),
+                "zero3-overlap" if overlap else "zero3",
+                (new_shards, new_state),
                 loss=lax.pmean(loss, axis), grads=gshards, params=pshards,
                 updates=updates, fallback=(pshards, ostate), axis=axis,
                 enabled=s_on, policy=s_policy,
@@ -390,9 +464,10 @@ def make_zero_partitioned_train_step(
     axis: str = "data",
     stage: int = 2,
     per_shard_rng: bool = True,
-    bucket_bytes: int | float | None = bucketing.DEFAULT_BUCKET_BYTES,
+    bucket_bytes: int | float | None = bucketing.AUTO,
     donate: bool | None = None,
     sentinel: bool | None = None,
+    overlap: bool = False,
 ):
     """ZeRO stage-1/2 trainstep: REPLICATED params, SHARDED optimizer
     state (and, at stage 2, sharded reduced gradients).
@@ -424,12 +499,26 @@ def make_zero_partitioned_train_step(
     with ``params`` replicated and ``opt_state`` in the ``[n, k]``
     sharded layout.
 
-    ``bucket_bytes`` (default 4 MiB) routes all three collectives through
+    ``bucket_bytes`` (default :data:`~ddl25spring_tpu.parallel.
+    bucketing.AUTO` = the ``DDL25_BUCKET_BYTES`` knob, 4 MiB unset)
+    routes all three collectives through
     flat buckets — the stage-1 all-reduce, the stage-2 reduce-scatter,
     and the updated-rows all-gather each launch once per BUCKET instead
     of once per leaf; ``donate`` (default on) aliases params/opt-state in
     place; ``sentinel`` opts into the in-step numerics sentinels over
     the sharded grad rows (:mod:`ddl25spring_tpu.obs.sentinels`).
+
+    ``overlap`` (requires bucketing): emit the gradient collective
+    inside the backward instead of after the full grad tree — params
+    route through a per-bucket ``custom_vjp`` (:func:`~ddl25spring_tpu.
+    parallel.bucketing.overlap_wrap`, buckets planned in backward-
+    readiness order) whose bwd rule issues the bucket's **all-reduce**
+    (stage 1) or **reduce-scatter into this device's rows** (stage 2)
+    as soon as that bucket's cotangents exist, overlappable with the
+    remaining backward compute.  The update-side all-gather is
+    unchanged (it depends on the optimizer output by construction).
+    Numerics match the post-hoc path within elementwise-reduction
+    equality — pinned in ``tests/test_bucketing.py``.
     """
     from ddl25spring_tpu.obs import sentinels as _sentinels
 
@@ -437,6 +526,12 @@ def make_zero_partitioned_train_step(
     if stage not in (1, 2):
         raise ValueError(f"stage must be 1 or 2, got {stage} "
                          "(stage 3 is make_zero_dp_train_step)")
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
+    if overlap and not bucket_bytes:
+        raise ValueError(
+            "overlap=True needs the bucketed path; pass a bucket_bytes "
+            "threshold (or leave the AUTO default)"
+        )
     n = mesh.shape[axis]
     treedef = jax.tree.structure(params_template)
     metas = [
@@ -444,7 +539,18 @@ def make_zero_partitioned_train_step(
         for l in jax.tree.leaves(params_template)
     ]
     shard_shapes = {(n, k) for _, k in metas}
-    plan = _row_plan(params_template, n, bucket_bytes) if bucket_bytes else None
+    plan = (
+        _row_plan(params_template, n, bucket_bytes,
+                  order="backward" if overlap else "forward")
+        if bucket_bytes else None
+    )
+    # the overlapped stage-1 all-reduce packs the RAW cotangents (flat
+    # concat, no row padding) — same wire bytes as the grads themselves
+    flat_plan = (
+        bucketing.plan_buckets(params_template, bucket_bytes,
+                               order="backward")
+        if overlap and stage == 1 else None
+    )
 
     def pack(leaf, meta):
         size, k = meta
@@ -476,29 +582,58 @@ def make_zero_partitioned_train_step(
             # invariant param's autodiff would psum pre-emptively under
             # VMA but not pre-VMA; the pcast makes both explicit)
             lparams = pcast(params, axis, to="varying")
-            loss, grads = jax.value_and_grad(loss_fn)(lparams, b, key)
-            g2d = pack_tree(grads)
             i = lax.axis_index(axis)
-            if plan is not None:
-                # packed [n, K_b] bucket buffers: one collective per
-                # bucket below instead of one per leaf
-                g2d = _pack_rows(plan, g2d)
+            if overlap:
+                # the grad collective fires inside the backward, per
+                # bucket: value_and_grad hands back the REDUCED grads
+                # (stage 1: the pmean'd full tree; stage 2: this
+                # device's scattered rows re-seated at row i of a
+                # zeroed padded layout) and the slice below is local
+                def reduced_loss(q):
+                    if stage == 1:
+                        q = bucketing.overlap_wrap(
+                            q, flat_plan,
+                            bucketing.flat_bucket_reduce(flat_plan, axis),
+                        )
+                    else:
+                        q = bucketing.overlap_wrap(
+                            q, plan,
+                            _overlap_row_scatter_reduce(plan, n, axis),
+                        )
+                    return loss_fn(q, b, key)
 
-            def reduce_to_shard(g):
-                if stage == 1:
-                    # sum everywhere (grad memory O(P)), then take our rows
-                    return lax.dynamic_slice_in_dim(
-                        lax.pmean(g, axis), i, 1, 0
-                    )
-                # stage 2: reduce straight into our rows (grad mem O(P/n))
-                return lax.psum_scatter(
-                    g, axis, scatter_dimension=0, tiled=True
-                ) / n
-
-            if plan is not None:
-                gshard = _split_rows(plan, [reduce_to_shard(g) for g in g2d])
+                loss, grads = jax.value_and_grad(reduced_loss)(lparams)
+                gshard = jax.tree.map(
+                    lambda g: lax.dynamic_slice_in_dim(g, i, 1, 0),
+                    pack_tree(grads),
+                )
             else:
-                gshard = jax.tree.map(reduce_to_shard, g2d)
+                loss, grads = jax.value_and_grad(loss_fn)(lparams, b, key)
+                g2d = pack_tree(grads)
+                if plan is not None:
+                    # packed [n, K_b] bucket buffers: one collective per
+                    # bucket below instead of one per leaf
+                    g2d = _pack_rows(plan, g2d)
+
+                def reduce_to_shard(g):
+                    if stage == 1:
+                        # sum everywhere (grad memory O(P)), then take
+                        # our rows
+                        return lax.dynamic_slice_in_dim(
+                            lax.pmean(g, axis), i, 1, 0
+                        )
+                    # stage 2: reduce straight into our rows (grad mem
+                    # O(P/n))
+                    return lax.psum_scatter(
+                        g, axis, scatter_dimension=0, tiled=True
+                    ) / n
+
+                if plan is not None:
+                    gshard = _split_rows(
+                        plan, [reduce_to_shard(g) for g in g2d]
+                    )
+                else:
+                    gshard = jax.tree.map(reduce_to_shard, g2d)
             pshard = jax.tree.map(
                 lambda p: lax.dynamic_slice_in_dim(p, i, 1, 0),
                 pack_tree(params),
@@ -506,7 +641,8 @@ def make_zero_partitioned_train_step(
             updates, new_state = tx.update(gshard, ostate, pshard)
             new_shard = optax.apply_updates(pshard, updates)
             new_shard, new_state = _sentinels.guard(
-                f"zero{stage}", (new_shard, new_state),
+                f"zero{stage}-overlap" if overlap else f"zero{stage}",
+                (new_shard, new_state),
                 loss=lax.pmean(loss, axis), grads=gshard, params=pshard,
                 updates=updates, fallback=(pshard, ostate), axis=axis,
                 enabled=s_on, policy=s_policy,
@@ -623,7 +759,7 @@ def make_zero3_llama_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis: str = "data",
-    bucket_bytes: int | float = bucketing.DEFAULT_BUCKET_BYTES,
+    bucket_bytes: int | float = bucketing.AUTO,
     prefetch: bool = True,
     per_shard_rng: bool = True,
     donate: bool | None = None,
@@ -671,6 +807,13 @@ def make_zero3_llama_train_step(
 
     s_on, s_policy = _sentinels.resolve(sentinel)
 
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
+    if not bucket_bytes:
+        raise ValueError(
+            "the scanned-LLaMA ZeRO-3 step is bucketed by construction; "
+            "bucket_bytes must be a positive threshold (DDL25_BUCKET_"
+            "BYTES=0 cannot apply here)"
+        )
     n = mesh.shape[axis]
     L = cfg.n_layers
     template = jax.eval_shape(
@@ -844,6 +987,8 @@ def describe(
     bucketed: bool = True,
     workload: str = "mlp",
     prefetch: bool = False,
+    overlap: bool = False,
+    bucket_bytes: int | float | None = None,
 ):
     """Registry hook for :mod:`ddl25spring_tpu.obs.xla_analytics`: the
     lowerable ZeRO train step (stage 1, 2, or 3) + example inputs + the
@@ -871,13 +1016,35 @@ def describe(
     :func:`make_zero3_llama_train_step`: the gather site sits INSIDE the
     layer scan — one all-gather per layer-bucket per trip, trip count ==
     ``n_layers``, the double-buffered overlap shape.
+
+    ``overlap=True`` describes the backward-issued variants
+    (``zero1-overlap`` / ``zero2-overlap`` / ``zero3-overlap``): stage
+    1's all-reduce packs the RAW grad bytes (flat concat, no row
+    padding) per backward-readiness bucket; stage 2's reduce-scatter
+    and stage 3's gather/scatter keep the padded row layout with
+    backward-ordered bucket composition.  Counts, axes, forbidden
+    kinds, and donation floors pin identically — the overlap is a
+    dataflow restructure, not a traffic change.  ``bucket_bytes`` pins
+    an explicit threshold for the sweep harness (default
+    :data:`~ddl25spring_tpu.parallel.bucketing.DEFAULT_BUCKET_BYTES`,
+    never the env knob — signatures must not drift with ambient
+    ``DDL25_BUCKET_BYTES``).
     """
     from ddl25spring_tpu.parallel.dp import _tiny_mlp_workload
 
+    if overlap and not bucketed:
+        raise ValueError("overlap describes the bucketed paths only")
+    if overlap and prefetch:
+        raise ValueError("prefetch is already the overlapped scanned-"
+                         "LLaMA shape; overlap applies to the whole-tree"
+                         " steps")
     n = mesh.shape[axis]
     key = jax.random.PRNGKey(0)
     slack = 256
-    bb = bucketing.DEFAULT_BUCKET_BYTES if bucketed else None
+    bb = (
+        (bucket_bytes or bucketing.DEFAULT_BUCKET_BYTES) if bucketed
+        else None
+    )
 
     if prefetch:
         if stage != 3 or not bucketed:
@@ -888,7 +1055,7 @@ def describe(
         tx = optax.sgd(0.1)
         shards = zero_shard_llama_params(params, mesh, axis)
         step = make_zero3_llama_train_step(
-            cfg, tx, mesh, axis, prefetch=True,
+            cfg, tx, mesh, axis, bucket_bytes=bb, prefetch=True,
             per_shard_rng=False, donate=True,
         )
         shard_bytes = sum(
@@ -914,6 +1081,7 @@ def describe(
                 "n_buckets": n_lb + n_ob,
                 "n_layer_buckets": n_lb,
                 "n_outer_buckets": n_ob,
+                "bucket_bytes": bb,
             },
             "expected": {
                 "scalar_bytes": 64,
@@ -954,7 +1122,11 @@ def describe(
     shards = zero_shard_params(params, mesh, axis)
     opt_state = tx.init(shards)
     n_leaves = len(jax.tree.leaves(params))
-    n_buckets = _row_plan(params, n, bb).n_buckets if bucketed else None
+    plan_order = "backward" if overlap else "forward"
+    n_buckets = (
+        _row_plan(params, n, bb, order=plan_order).n_buckets
+        if bucketed else None
+    )
     # collective sites per sweep over the tree: one per bucket when
     # packing, one per leaf otherwise
     launches = n_buckets if bucketed else n_leaves
@@ -962,7 +1134,7 @@ def describe(
         step = make_zero_dp_train_step(
             loss_fn, tx, mesh, params, axis,
             per_shard_rng=False, instrument=False,
-            bucket_bytes=bb, donate=True,
+            bucket_bytes=bb, donate=True, overlap=overlap,
         )
         args = (shards, opt_state, batch, key)
         expected = {
@@ -993,6 +1165,7 @@ def describe(
         step = make_zero_partitioned_train_step(
             loss_fn, tx, mesh, params, axis, stage=stage,
             per_shard_rng=False, bucket_bytes=bb, donate=True,
+            overlap=overlap,
         )
         args = (params, opt_state, batch, key)
         expected = {
@@ -1008,12 +1181,27 @@ def describe(
             "donation": {"min_saved_bytes": param_bytes},
         }
         if stage == 1:
+            # the overlapped variant all-reduces the RAW cotangent
+            # bytes (flat concat in the bwd rule, no row padding) over
+            # its own flat backward-readiness plan; the sync path moves
+            # the padded row layout.  meta's n_buckets follows the GRAD
+            # plan — the launch structure a bucket sweep actually
+            # varies — while the update gather keeps the row plan
+            # (n_update_buckets below).
+            grad_launches = (
+                bucketing.plan_buckets(
+                    params, bb, order="backward"
+                ).n_buckets
+                if overlap else launches
+            )
+            if overlap:
+                n_update_buckets, n_buckets = n_buckets, grad_launches
             expected["all-reduce"] = {
-                "min_bytes": padded_bytes,
+                "min_bytes": param_bytes if overlap else padded_bytes,
                 "max_bytes": padded_bytes + slack,
                 "axes": [axis],
                 # + up to 2 scalar loss reductions ride along
-                "max_count": launches + 2,
+                "max_count": grad_launches + 2,
             }
             expected["forbidden"].append("reduce-scatter")
         else:
@@ -1038,6 +1226,16 @@ def describe(
             "padded_param_bytes": padded_bytes,
             "n_param_leaves": n_leaves,
             **({"n_buckets": n_buckets} if bucketed else {}),
+            # stage-1 overlap: the grad all-reduce rides the flat plan
+            # (n_buckets above) while the update gather keeps the row
+            # plan — both counts recorded so sweeps and signature
+            # readers never conflate them
+            **(
+                {"n_update_buckets": n_update_buckets}
+                if overlap and stage == 1 and bucketed else {}
+            ),
+            **({"bucket_bytes": bb} if bucketed else {}),
+            **({"overlap": True} if overlap else {}),
         },
         "expected": expected,
     }

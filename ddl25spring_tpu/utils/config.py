@@ -126,3 +126,17 @@ def env_float(name: str, default: float) -> float:
     if not raw:
         return default
     return float(raw)
+
+
+def env_int(name: str, default: int) -> int:
+    """Read an integer ``DDL25_*`` setting through the sanctioned env
+    boundary (see :func:`env_flag`).  Unset/empty -> ``default``; a
+    non-integer value raises immediately (a typo'd byte count silently
+    falling back would make e.g. a bucket-size sweep recommendation
+    look applied when it wasn't)."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    return int(raw)

@@ -72,6 +72,48 @@ def test_plan_single_bucket_under_threshold_and_oversize_leaf():
     assert plan.n_buckets == 2  # an oversize leaf still lands somewhere
 
 
+def test_plan_backward_order_groups_by_readiness():
+    """order="backward" walks the leaves in reversed flatten order —
+    bucket 0 holds the LAST leaves (the first cotangents the backward
+    produces) — and still round-trips pack/unpack exactly."""
+    tree = {f"l{i}": jnp.zeros((256,), jnp.float32) for i in range(4)}
+    fwd = bucketing.plan_buckets(tree, bucket_bytes=2 * 1024)
+    bwd = bucketing.plan_buckets(tree, bucket_bytes=2 * 1024,
+                                 order="backward")
+    assert fwd.buckets == ((0, 1), (2, 3))
+    assert bwd.buckets == ((3, 2), (1, 0))
+    vals = {f"l{i}": jnp.arange(256.0) + i for i in range(4)}
+    back = bwd.unpack(bwd.pack(vals))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        vals, back,
+    )
+    with pytest.raises(ValueError, match="order"):
+        bucketing.plan_buckets(tree, order="sideways")
+
+
+def test_bucket_bytes_env_knob(monkeypatch):
+    """DDL25_BUCKET_BYTES resolves through the sanctioned env boundary:
+    AUTO -> the knob (0 = per-leaf), explicit values pass through, and
+    None keeps meaning per-leaf as it has since PR 3."""
+    monkeypatch.delenv("DDL25_BUCKET_BYTES", raising=False)
+    assert bucketing.resolve_bucket_bytes(bucketing.AUTO) == (
+        bucketing.DEFAULT_BUCKET_BYTES
+    )
+    monkeypatch.setenv("DDL25_BUCKET_BYTES", str(1 << 20))
+    assert bucketing.resolve_bucket_bytes(bucketing.AUTO) == 1 << 20
+    monkeypatch.setenv("DDL25_BUCKET_BYTES", "0")
+    assert bucketing.resolve_bucket_bytes(bucketing.AUTO) is None
+    assert bucketing.resolve_bucket_bytes(None) is None
+    assert bucketing.resolve_bucket_bytes(0) is None
+    assert bucketing.resolve_bucket_bytes(2048) == 2048
+    monkeypatch.setenv("DDL25_BUCKET_BYTES", "not-bytes")
+    with pytest.raises(ValueError):
+        bucketing.resolve_bucket_bytes(bucketing.AUTO)
+
+
 def test_pack_unpack_roundtrip_mixed_dtypes():
     key = jax.random.PRNGKey(0)
     tree = {
@@ -275,6 +317,126 @@ def test_zero3_llama_prefetch_holds_sharded_state(devices8):
     assert sum(s.data.shape[1] for s in local) == 1  # one row of each layer
     mu = o_z[0].mu["blocks"]["wq"]
     assert mu.shape == wq.shape
+
+
+# ------------------------------------------------------- overlapped backward
+
+
+def test_dp_overlap_equals_per_leaf_bitwise(mlp4):
+    """The PR-8 acceptance pin: the backward-overlapped DP step — each
+    bucket's all-reduce emitted by its custom_vjp bwd rule, buckets in
+    backward-readiness order — lands BITWISE where per-leaf sync DP
+    lands (psum is elementwise; packing and issue order commute with
+    it)."""
+    mesh, params, loss_fn, batch = mlp4
+    tx = optax.adam(1e-2)
+    key = jax.random.PRNGKey(0)
+    per_leaf = make_dp_train_step(
+        loss_fn, tx, mesh, per_shard_rng=False, bucket_bytes=None
+    )
+    overlapped = make_dp_train_step(
+        loss_fn, tx, mesh, per_shard_rng=False, overlap=True
+    )
+    p1, o1 = params, tx.init(params)
+    p2, o2 = params, tx.init(params)
+    for _ in range(3):
+        p1, o1, l1 = per_leaf(p1, o1, batch, key)
+        p2, o2, l2 = overlapped(p2, o2, batch, key)
+        assert float(l1) == float(l2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        jax.device_get(p1), jax.device_get(p2),
+    )
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_overlap_equals_sync(stage, mlp4):
+    """Every ZeRO overlap variant — stage 1's bwd-issued all-reduce,
+    stage 2's bwd-issued reduce-scatter (re-seated at row i of the
+    padded layout), stage 3's backward-ordered gather plan — trains
+    within the suite grad tolerance of its sync twin."""
+    mesh, params, loss_fn, batch = mlp4
+    tx = optax.adam(1e-2)
+    key = jax.random.PRNGKey(0)
+    if stage == 3:
+        mk = lambda ov: make_zero_dp_train_step(  # noqa: E731
+            loss_fn, tx, mesh, params, per_shard_rng=False, overlap=ov
+        )
+        s1, s2 = (
+            zero_shard_params(params, mesh), zero_shard_params(params, mesh)
+        )
+        a1, a2 = s1, s2
+    else:
+        mk = lambda ov: make_zero_partitioned_train_step(  # noqa: E731
+            loss_fn, tx, mesh, params, stage=stage, per_shard_rng=False,
+            overlap=ov,
+        )
+        a1 = a2 = params
+    o1 = tx.init(zero_shard_params(params, mesh))
+    o2 = tx.init(zero_shard_params(params, mesh))
+    sync, overlapped = mk(False), mk(True)
+    for _ in range(3):
+        a1, o1, l1 = sync(a1, o1, batch, key)
+        a2, o2, l2 = overlapped(a2, o2, batch, key)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    unshard = (
+        (lambda t: zero_unshard_params(jax.device_get(t), params))
+        if stage == 3 else jax.device_get
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, rtol=2e-6
+        ),
+        unshard(a1), unshard(a2),
+    )
+
+
+def test_weight_avg_bucketed_sync_equals_per_leaf(mlp4):
+    """The third DP variant: weight-aggregation DP's params-pmean rides
+    the flat-bucket path now (it had stayed per-leaf through PR 3) —
+    bitwise-equal, same oracle as the gradient path."""
+    from ddl25spring_tpu.parallel.dp import (
+        make_dp_weight_avg_step,
+        stack_opt_state,
+    )
+
+    mesh, params, loss_fn, batch = mlp4
+    tx = optax.sgd(0.1)
+    key = jax.random.PRNGKey(0)
+    per_leaf = make_dp_weight_avg_step(
+        loss_fn, tx, mesh, per_shard_rng=False, bucket_bytes=None
+    )
+    bucketed = make_dp_weight_avg_step(
+        loss_fn, tx, mesh, per_shard_rng=False
+    )
+    o1 = stack_opt_state(tx.init(params), 4)
+    o2 = stack_opt_state(tx.init(params), 4)
+    p1, p2 = params, params
+    for _ in range(2):
+        p1, o1, l1 = per_leaf(p1, o1, batch, key)
+        p2, o2, l2 = bucketed(p2, o2, batch, key)
+        assert float(l1) == float(l2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        jax.device_get(p1), jax.device_get(p2),
+    )
+
+
+def test_overlap_requires_bucketing(mlp4):
+    mesh, params, loss_fn, _ = mlp4
+    tx = optax.sgd(0.1)
+    with pytest.raises(ValueError, match="bucketed path"):
+        make_dp_train_step(
+            loss_fn, tx, mesh, bucket_bytes=None, overlap=True
+        )
+    with pytest.raises(ValueError, match="bucketed path"):
+        make_zero_dp_train_step(
+            loss_fn, tx, mesh, params, bucket_bytes=0, overlap=True
+        )
 
 
 # --------------------------------------------------------------- donation

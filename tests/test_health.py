@@ -108,11 +108,24 @@ def _builder_setups(devices8):
         )
         return step, (p, tx.init(p), batch, key)
 
+    def dp_overlap():
+        step = dp.make_dp_train_step(
+            _mlp_loss, tx, mesh2, per_shard_rng=False, overlap=True
+        )
+        return step, (p, tx.init(p), batch, key)
+
     def dp_wavg():
         step = dp.make_dp_weight_avg_step(
             _mlp_loss, tx, mesh2, per_shard_rng=False
         )
         return step, (p, dp.stack_opt_state(tx.init(p), 2), batch, key)
+
+    def zero3_overlap():
+        step = zero.make_zero_dp_train_step(
+            _mlp_loss, tx, mesh2, p, per_shard_rng=False, overlap=True
+        )
+        shards = zero.zero_shard_params(p, mesh2)
+        return step, (shards, tx.init(shards), batch, key)
 
     def zero_stage(stage):
         def build():
@@ -213,11 +226,13 @@ def _builder_setups(devices8):
     setups = {
         "serial": serial,
         "dp": dp_grad,
+        "dp-overlap": dp_overlap,
         "dp-weight-avg": dp_wavg,
         "zero1": zero_stage(1),
         "zero2": zero_stage(2),
         "zero3": zero_stage(3),
         "zero3-prefetch": zero3_llama,
+        "zero3-overlap": zero3_overlap,
         "tp": tp_step,
         "sp": sp_step,
         "ep": ep_step,
@@ -266,6 +281,44 @@ def test_every_builder_hlo_identical_when_disabled(devices8):
 def test_default_follows_global_flag(devices8, name):
     assert _lowered(devices8, name, "default") == _lowered(
         devices8, name, "off"
+    )
+
+
+def test_sentinels_do_not_serialize_overlapped_collectives(devices8):
+    """The PR-8 interaction pin: enabling sentinels on the overlapped
+    DP step must not add (or force) any non-scalar collective — the
+    guard's facts ride scalar reductions + one host callback, so the
+    backward-issued bucket all-reduces keep their overlap structure.
+    Compares the OPTIMIZED HLO collective inventories of the on/off
+    builds: identical non-scalar sites, and everything the guard added
+    is scalar-sized."""
+    from ddl25spring_tpu.obs.xla_analytics import parse_hlo_collectives
+    from ddl25spring_tpu.parallel import dp
+
+    tx = optax.sgd(0.1)
+    p = _mlp_params()
+    batch = _mlp_batch()
+    key = jax.random.PRNGKey(0)
+    mesh2 = make_mesh(devices8[:2], data=2)
+
+    def compiled_ops(on: bool):
+        with sentinels.scoped(on):
+            step = dp.make_dp_train_step(
+                _mlp_loss, tx, mesh2, per_shard_rng=False, overlap=True
+            )
+        hlo = step.lower(p, tx.init(p), batch, key).compile().as_text()
+        return parse_hlo_collectives(hlo)
+
+    def big(ops):
+        return sorted(
+            (o["kind"], o["result_bytes"], o["count"])
+            for o in ops if o["result_bytes"] > 64
+        )
+
+    off_ops, on_ops = compiled_ops(False), compiled_ops(True)
+    assert big(on_ops) == big(off_ops), (
+        "sentinels changed the overlapped step's non-scalar collective "
+        "structure — the guard is serializing the bucket all-reduces"
     )
 
 

@@ -78,6 +78,40 @@ def test_h001_sync_collective_fires_and_async_is_exempt():
     assert "H001" not in _rules_fired(_lint(small))
 
 
+# a PROPERLY paired async all-reduce: -start issues, independent
+# compute runs (the overlap), -done collects — the exact shape the
+# overlapped strategies must lower to on hardware with async-collective
+# support, and the fix H001's hint prescribes
+H001_ASYNC_PAIRED = f"""\
+HloModule h001async
+{_ADD}
+ENTRY %main (x: f32[1048576], y: f32[1048576]) -> f32[1048576] {{
+  %x = f32[1048576]{{0}} parameter(0)
+  %y = f32[1048576]{{0}} parameter(1)
+  %ars = f32[1048576]{{0}} all-reduce-start(f32[1048576]{{0}} %x), replica_groups={{{{0,1,2,3}}}}, to_apply=%add
+  %w = f32[1048576]{{0}} multiply(f32[1048576]{{0}} %y, f32[1048576]{{0}} %y)
+  %ard = f32[1048576]{{0}} all-reduce-done(f32[1048576]{{0}} %ars)
+  ROOT %out = f32[1048576]{{0}} add(f32[1048576]{{0}} %ard, f32[1048576]{{0}} %w)
+}}
+"""
+
+
+def test_h001_paired_async_collective_passes():
+    """The negative the overlap work pins: a 4 MiB all-reduce lowered
+    as a start/done pair with intervening compute is the OVERLAPPED
+    form — H001 must stay quiet, and the parser must count the pair as
+    ONE async op site (the -done op never double-counts)."""
+    fs = _lint(H001_ASYNC_PAIRED)
+    assert "H001" not in _rules_fired(fs)
+    from ddl25spring_tpu.obs.xla_analytics import parse_hlo_collectives
+
+    ops = parse_hlo_collectives(H001_ASYNC_PAIRED)
+    ars = [o for o in ops if o["kind"] == "all-reduce"]
+    assert len(ars) == 1
+    assert ars[0]["async"] is True
+    assert ars[0]["result_bytes"] == 4 * 1048576
+
+
 def test_h001_judges_wire_bytes_not_result_shape():
     """A reduce-scatter's RESULT is payload/n, but (n-1) result-sized
     shards cross the wire — the rule must catch it despite the small

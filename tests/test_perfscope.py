@@ -68,7 +68,10 @@ def test_decomposition_invariants():
     # dp's grad all-reduce is real traffic on this mesh: the micro cost
     # model must have priced it
     assert rec["micro_total_s"] > 0
-    assert rec["overlap_eff"] is None or 0.0 <= rec["overlap_eff"] <= 1.0
+    # capped at 1.0, deliberately NOT floored at 0: negative efficiency
+    # is the contended-fake-mesh signal (exposure beyond the comms
+    # bill) that before/after comparisons and --min-overlap-eff need
+    assert rec["overlap_eff"] is None or rec["overlap_eff"] <= 1.0
 
 
 def test_micro_costs_cover_inventory_exactly():
@@ -242,6 +245,78 @@ def test_check_is_per_host_and_needs_a_baseline(tmp_path, capsys):
     assert perf_report.main(
         ["--ledger", str(tmp_path / "absent.jsonl")]
     ) == 0
+
+
+def test_min_overlap_eff_floor_gates_and_skips_undefined(tmp_path, capsys):
+    """The --min-overlap-eff satellite: an absolute floor on the latest
+    record's measured overlap efficiency — gates even a single fresh
+    record, skips keys whose efficiency is undefined, and stays out of
+    the way when the flag is absent."""
+    import tools.perf_report as perf_report
+
+    led = str(tmp_path / "ledger.jsonl")
+    base = perfscope.measure_callable(
+        *_toy_step(), strategy="toy", reps=2, warmup=1
+    )
+    low = dict(base, strategy="ov-low", overlap_eff=0.2,
+               exposed_comms_s=0.008, micro_total_s=0.01)
+    high = dict(base, strategy="ov-high", overlap_eff=0.9,
+                exposed_comms_s=0.001, micro_total_s=0.01)
+    undefined = dict(base, strategy="ov-none", overlap_eff=None)
+    for r in (low, high, undefined):
+        perfscope.append_ledger(r, led)
+    # floor above the low record's 0.2: exactly one key fails
+    assert perf_report.main(
+        ["--ledger", led, "--check", "--min-overlap-eff", "0.5"]
+    ) == 1
+    err = capsys.readouterr().err
+    fails = [l for l in err.splitlines() if l.startswith("CHECK FAIL")]
+    assert len(fails) == 1
+    assert "ov-low" in fails[0] and "overlap_eff 0.200" in fails[0]
+    # floor below every defined record: passes (undefined key skipped)
+    assert perf_report.main(
+        ["--ledger", led, "--check", "--min-overlap-eff", "0.1"]
+    ) == 0
+    # no flag: the floor never engages
+    assert perf_report.main(["--ledger", led, "--check"]) == 0
+
+
+def test_dp_record_carries_bucket_knob_fields():
+    """Sweep comparability: every strategy record names the bucket
+    threshold + plan it measured (the DDL25_BUCKET_BYTES knob's value
+    at build time) so grid points and env-knob runs never mix
+    silently."""
+    from ddl25spring_tpu.parallel import bucketing
+
+    rec = _dp_record()
+    assert rec["bucket_bytes"] == bucketing.DEFAULT_BUCKET_BYTES
+    assert rec["n_buckets"] == 1
+
+
+def test_bucket_sweep_measures_grid_and_recommends(tmp_path):
+    """tools/bucket_sweep.py: one re-tagged record per grid point (the
+    perf gate never sees them), exactly one marked best, and the best
+    is the measured-fastest."""
+    from tools.bucket_sweep import render_table, sweep_strategy
+
+    records = sweep_strategy(
+        "dp", (1024, 4 * 1024 * 1024), reps=2, warmup=1, micro_reps=1
+    )
+    assert len(records) == 2
+    assert all(r["record"] == "bucket_sweep" for r in records)
+    assert [r["bucket_bytes"] for r in records] == [1024, 4 * 1024 * 1024]
+    # the 1 KiB grid point splits the 2.6 KiB MLP tree; 4 MiB holds it
+    assert records[0]["n_buckets"] > records[1]["n_buckets"] == 1
+    best = [r for r in records if r.get("best")]
+    assert len(best) == 1
+    assert best[0]["step_s_p50"] == min(r["step_s_p50"] for r in records)
+    table = render_table("dp", records)
+    assert "best" in table and "bucket_bytes" in table
+    # sweep records are invisible to the perf regression gate
+    led = str(tmp_path / "ledger.jsonl")
+    for r in records:
+        perfscope.append_ledger(r, led)
+    assert perfscope.read_ledger(led) == []
 
 
 # ------------------------------------------------ H001 cross-referencing

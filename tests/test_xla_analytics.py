@@ -289,6 +289,44 @@ def test_dp_signature_exactly_one_fused_gradient_allreduce():
     )
 
 
+def test_dp_overlap_signature_matches_dp_with_backward_issue():
+    """The overlapped DP strategy is a scheduling restructure, not a
+    traffic change: identical all-reduce payload, the same per-bucket
+    launch ceiling, data-axis-only grouping, and the same forbidden
+    kinds as sync dp — any drift here means the custom_vjp machinery
+    changed what crosses the wire.  The meta declares the mode so every
+    downstream consumer (perfscope records, comms tables) names it."""
+    r = _report("dp-overlap")
+    sync = _report("dp")
+    assert r["signature_violations"] == []
+    assert r["meta"]["overlap"] is True
+    assert r["meta"]["bucket_bytes"] == sync["meta"]["bucket_bytes"]
+    # same bytes on the wire as sync dp, same bucket-count launch shape
+    assert _payload(r, "all-reduce") == _payload(sync, "all-reduce")
+    big = [
+        o for o in r["collectives"]["ops"]
+        if o["kind"] == "all-reduce" and o["result_bytes"] > 64
+    ]
+    assert sum(o["count"] for o in big) == r["meta"]["n_buckets"]
+    for kind in ("all-gather", "reduce-scatter", "collective-permute",
+                 "all-to-all"):
+        assert _count(r, kind) == 0, f"dp-overlap grew a stray {kind}"
+
+
+def test_zero3_overlap_signature_matches_zero3():
+    """zero3-overlap re-plans the row buckets in backward-readiness
+    order — gather/scatter counts, payloads, and the no-param-all-reduce
+    invariant pin identically to sync zero3."""
+    r = _report("zero3-overlap")
+    sync = _report("zero3")
+    assert r["signature_violations"] == []
+    assert r["meta"]["overlap"] is True
+    for kind in ("all-gather", "reduce-scatter"):
+        assert _count(r, kind) == _count(sync, kind)
+        assert _payload(r, kind) == _payload(sync, kind)
+    assert _payload(r, "all-reduce") <= 64
+
+
 def test_zero3_signature_bucketed_gathers_and_scatters():
     r = _report("zero3")
     assert r["signature_violations"] == []

@@ -1,0 +1,198 @@
+"""Ledger-driven bucket-size autotuner: sweep ``bucket_bytes`` over a
+grid, measure each point with the perfscope machinery, and emit the best
+size as a ``DDL25_BUCKET_BYTES`` recommendation.
+
+    python tools/bucket_sweep.py --strategy dp
+    python tools/bucket_sweep.py --strategy dp-overlap,zero3-overlap \
+        --grid 65536,262144,1048576,4194304
+    python tools/bucket_sweep.py --strategy zero3 --workload llama --reps 8
+
+The 4 MiB default bucket threshold (PR 3) was a literature constant,
+never measured on this framework's programs: too small and every launch
+pays the fixed collective cost the bucketing exists to amortize, too
+large and one transfer monopolizes the wire (and, in the overlapped
+mode, the last bucket has nothing left to hide behind).  The sweet spot
+is host- and strategy-specific, which is exactly what the perf ledger's
+(strategy, mesh, host) trend identity models — so this tool reuses the
+perfscope steady-state step timing + per-collective micro-costing per
+grid point and appends one record per (strategy, bucket_bytes) to the
+ledger.
+
+Sweep records carry ``"record": "bucket_sweep"`` (not ``"perf"``), so
+``tools/perf_report.py --check`` never mistakes a deliberately-detuned
+grid point for a regression; the winning size is additionally recorded
+as ``"bucket_sweep_best"``.  Apply a recommendation by exporting
+``DDL25_BUCKET_BYTES=<bytes>`` — every train-step builder resolves it
+at build time (``parallel/bucketing.default_bucket_bytes``), and BENCH
+lines / perf records carry the effective value so before/after runs
+stay comparable.
+
+Caveats: fake CPU devices share this host's cores, so absolute
+milliseconds are host-relative — compare grid points within one run,
+and re-sweep on the deployment host before exporting the knob there.
+Registry describe() workloads are deliberately tiny; sizes above the
+whole tree collapse to one bucket (the table's ``n_buckets`` column
+shows where the grid stops mattering).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO_ROOT))
+
+from ddl25spring_tpu.utils.platform import ensure_cpu_tools_env  # noqa: E402
+
+ensure_cpu_tools_env()
+
+DEFAULT_GRID = (
+    4 * 1024, 16 * 1024, 64 * 1024, 256 * 1024,
+    1024 * 1024, 4 * 1024 * 1024,
+)
+
+
+def sweep_strategy(
+    name: str,
+    grid: tuple[int, ...],
+    mesh_sizes: tuple[int, ...] | None = None,
+    *,
+    reps: int = 6,
+    warmup: int = 2,
+    micro_reps: int = 3,
+    **overrides,
+) -> list[dict]:
+    """One perfscope measurement per grid point (no 1-device
+    counterfactual — compute is bucket-size-invariant, only the launch
+    structure changes).  Returns the re-tagged sweep records, best
+    (lowest step p50) first annotated via ``"best": True``."""
+    from ddl25spring_tpu.obs.perfscope import measure_strategy
+
+    records = []
+    for bb in grid:
+        try:
+            rec = measure_strategy(
+                name, mesh_sizes, reps=reps, warmup=warmup,
+                micro_reps=micro_reps, rounds=1,
+                compute_counterfactual=False,
+                bucket_bytes=int(bb), **overrides,
+            )[0]
+        except Exception as e:  # noqa: BLE001 — one bad grid point
+            records.append({
+                "record": "bucket_sweep", "strategy": name,
+                "bucket_bytes": int(bb),
+                "error": f"{type(e).__name__}: {e}",
+            })
+            continue
+        rec["record"] = "bucket_sweep"
+        rec.pop("findings", None)  # per-point lint adds nothing here
+        records.append(rec)
+    timed = [r for r in records if r.get("step_s_p50")]
+    if timed:
+        min(timed, key=lambda r: r["step_s_p50"])["best"] = True
+    return records
+
+
+def render_table(name: str, records: list[dict]) -> str:
+    from ddl25spring_tpu.utils.metrics import fmt_bytes
+
+    lines = [f"strategy {name}"]
+    head = (f"  {'bucket_bytes':>14}{'n_buckets':>11}{'step p50':>12}"
+            f"{'p95':>12}{'micro total':>13}")
+    lines += [head, "  " + "-" * (len(head) - 2)]
+    for r in records:
+        if "error" in r:
+            lines.append(f"  {fmt_bytes(r['bucket_bytes']):>14}  "
+                         f"FAILED: {r['error']}")
+            continue
+        mark = "  <- best" if r.get("best") else ""
+        lines.append(
+            f"  {fmt_bytes(r.get('bucket_bytes')):>14}"
+            f"{r.get('n_buckets', '?'):>11}"
+            f"{r['step_s_p50'] * 1e3:>10.3f} ms"
+            f"{r['step_s_p95'] * 1e3:>10.3f} ms"
+            f"{r.get('micro_total_s', 0.0) * 1e3:>10.3f} ms{mark}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    import jax
+
+    # env alone is too late on images whose sitecustomize registers a
+    # TPU plugin at interpreter start; the config call forces CPU
+    jax.config.update("jax_platforms", "cpu")
+
+    from ddl25spring_tpu.obs.compile_report import parse_mesh_arg
+    from ddl25spring_tpu.obs.perfscope import DEFAULT_LEDGER, append_ledger
+
+    ap = argparse.ArgumentParser(
+        prog="bucket_sweep", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--strategy", default="dp",
+                    help="comma-separated registered strategy names "
+                         "(see obs/xla_analytics.STRATEGIES)")
+    ap.add_argument("--grid", default=None,
+                    help="comma-separated bucket_bytes values (default: "
+                         + ",".join(str(g) for g in DEFAULT_GRID) + ")")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh sizes like 2x4, positional onto each "
+                         "strategy's axis names")
+    ap.add_argument("--workload", default=None,
+                    help="describe() workload override (e.g. llama for "
+                         "the zero strategies' 12-leaf tree)")
+    ap.add_argument("--reps", type=int, default=6)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--micro-reps", type=int, default=3)
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER, metavar="JSONL",
+                    help=f"append sweep records here (default "
+                         f"{DEFAULT_LEDGER}; '-' disables)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the sweep records as JSON")
+    args = ap.parse_args(argv)
+
+    grid = tuple(
+        int(x) for x in (args.grid or "").split(",") if x.strip()
+    ) or DEFAULT_GRID
+    overrides = {"workload": args.workload} if args.workload else {}
+    names = [s.strip() for s in args.strategy.split(",") if s.strip()]
+
+    rc = 0
+    all_records: dict[str, list[dict]] = {}
+    for name in names:
+        records = sweep_strategy(
+            name, grid, parse_mesh_arg(args.mesh),
+            reps=args.reps, warmup=args.warmup,
+            micro_reps=args.micro_reps, **overrides,
+        )
+        all_records[name] = records
+        best = next((r for r in records if r.get("best")), None)
+        if args.ledger != "-":
+            for r in records:
+                append_ledger(r, args.ledger)
+            if best is not None:
+                append_ledger(
+                    {**best, "record": "bucket_sweep_best"}, args.ledger
+                )
+        if not args.json:
+            print(render_table(name, records))
+            if best is not None:
+                print(f"  recommendation: export DDL25_BUCKET_BYTES="
+                      f"{best['bucket_bytes']}\n")
+            else:
+                print(f"  no grid point measured for {name}\n")
+                rc = 1
+        elif best is None:
+            rc = 1
+    if args.json:
+        print(json.dumps(all_records, indent=1, default=str))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

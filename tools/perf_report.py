@@ -85,6 +85,28 @@ def _median(xs: list[float]) -> float | None:
     return statistics.median(xs) if xs else None
 
 
+def check_overlap_floor(recs: list[dict], min_overlap_eff: float) -> list[str]:
+    """The ``--min-overlap-eff`` gate: the latest record's measured
+    overlap efficiency must not sit under the floor.  Unlike the
+    relative bands of :func:`check_group` this is an absolute floor and
+    needs no baseline — a single fresh record already gates.  Records
+    whose ``overlap_eff`` is None (no costed collectives on this mesh,
+    e.g. a 1-chip run) are skipped: an undefined efficiency is not a
+    regressed one."""
+    if not recs:
+        return []
+    eff = recs[-1].get("overlap_eff")
+    if isinstance(eff, (int, float)) and eff < min_overlap_eff:
+        return [
+            f"overlap_eff {eff:.3f} fell under the --min-overlap-eff "
+            f"{min_overlap_eff:.3f} floor (exposed comms "
+            f"{_fmt(recs[-1].get('exposed_comms_s'), 3, 1e3, ' ms')} vs "
+            f"micro total "
+            f"{_fmt(recs[-1].get('micro_total_s'), 3, 1e3, ' ms')})"
+        ]
+    return []
+
+
 def check_group(
     recs: list[dict],
     tolerance: float = DEFAULT_TOLERANCE,
@@ -186,6 +208,14 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero when any key's latest record "
                          "regresses past the band (the CI perf gate)")
+    ap.add_argument("--min-overlap-eff", type=float, default=None,
+                    metavar="F",
+                    help="with --check: also fail when any key's latest "
+                         "record has a measured overlap_eff below this "
+                         "absolute floor (keys whose overlap_eff is "
+                         "undefined — no costed collectives — are "
+                         "skipped).  CI catches overlap regressions, "
+                         "not just wall-clock ones")
     args = ap.parse_args(argv)
 
     records = read_ledger(args.ledger)
@@ -215,17 +245,28 @@ def main(argv=None) -> int:
         bad = 0
         for key, recs in groups.items():
             label = f"{key[0]} mesh({key[1]})"
+            fails: list[str] = []
+            if args.min_overlap_eff is not None:
+                # the absolute floor gates even a single fresh record
+                fails += check_overlap_floor(recs, args.min_overlap_eff)
             if len(recs) < 2:
-                print(f"CHECK NOTE {label}: no baseline yet "
-                      "(single record)", file=sys.stderr)
-                continue
-            for fail in check_group(recs, args.tolerance, args.window):
+                if not fails:
+                    print(f"CHECK NOTE {label}: no baseline yet "
+                          "(single record)", file=sys.stderr)
+            else:
+                fails += check_group(recs, args.tolerance, args.window)
+            for fail in fails:
                 print(f"CHECK FAIL {label}: {fail}", file=sys.stderr)
                 bad += 1
         if bad:
             return 1
+        floor = (
+            f", overlap_eff floor {args.min_overlap_eff:.2f}"
+            if args.min_overlap_eff is not None else ""
+        )
         print(f"\nperf check OK: {len(groups)} key(s) within the "
-              f"{args.tolerance:.2f} tolerance band", file=sys.stderr)
+              f"{args.tolerance:.2f} tolerance band{floor}",
+              file=sys.stderr)
     return 0
 
 
