@@ -74,6 +74,11 @@ class HloLintContext:
     fusion_callers: dict[str, tuple[str, dict]] = field(
         default_factory=dict
     )
+    # whole-program schedule report (analysis/sched.py): per-collective
+    # overlap-slack windows + participant-stream safety hazards — what
+    # H008/H009 judge.  None when the sched pass failed (its breakage
+    # must never cost the other rules)
+    sched: dict[str, Any] | None = None
 
     # -------------------------------------------------- rule conveniences
 
@@ -191,6 +196,25 @@ def build_context(
         if report and report.get("entry_params") is not None
         else xa.parse_entry_parameters(hlo_text)
     )
+    merged_thresholds = {**DEFAULT_THRESHOLDS, **(thresholds or {})}
+    # the schedule report: reuse the one analyze_compiled already built
+    # for this report (one DAG pass per compile), else build it here
+    # (synthetic-HLO lints); a sched failure degrades to None so the
+    # H001-H007 pass never pays for it
+    sched_report = (report or {}).get("sched")
+    if sched_report is None:
+        try:
+            from ddl25spring_tpu.analysis import sched as sched_mod
+
+            sched_report = sched_mod.analyze_schedule(
+                hlo_text,
+                mesh,
+                ops=ops,
+                discipline=sched_mod.discipline_of((report or {}).get("meta")),
+                scalar_bytes=merged_thresholds["scalar_bytes"],
+            )
+        except Exception:  # noqa: BLE001 — degrade, keep the lint pass
+            sched_report = None
     return HloLintContext(
         ops=ops,
         defs=defs,
@@ -200,10 +224,11 @@ def build_context(
         report=report,
         strategy=strategy,
         obs_enabled=bool(obs_enabled),
-        thresholds={**DEFAULT_THRESHOLDS, **(thresholds or {})},
+        thresholds=merged_thresholds,
         invariant_gtes=_invariant_gtes(defs),
         reachable_comps=reachable,
         fusion_callers=fusion_callers,
+        sched=sched_report,
     )
 
 
@@ -294,10 +319,15 @@ def summarize(findings: list[Finding | dict]) -> dict[str, Any]:
 
 
 def attach_measured_costs(
-    findings: list[dict], perf_record: dict[str, Any]
+    findings: list[dict],
+    perf_record: dict[str, Any],
+    sched: dict[str, Any] | None = None,
+    strategy: str | None = None,
+    waivers: list | None = None,
 ) -> int:
     """Cross-reference a perfscope record (:mod:`ddl25spring_tpu.obs.
-    perfscope`) onto H001 findings, in place.
+    perfscope`) onto H001 findings, in place — and price the schedule's
+    overlap windows (H010).
 
     H001 says "this sync collective leaves overlap on the table" — a
     judgment with no price tag until a measurement exists.  Each H001
@@ -310,6 +340,15 @@ def attach_measured_costs(
     still gain the strategy-level context.  Only dict findings are
     annotated (``Finding.to_dict()`` upstream).  Returns the number of
     findings annotated.
+
+    With ``sched`` (the ``analysis/sched.py`` report riding the same
+    compile), every overlap window is additionally priced against the
+    measured micro-cost of its own op: windows that cannot hide the
+    transfer even in principle append **H010** findings to
+    ``findings`` (waiver-resolved against ``waivers``, default the repo
+    waiver file) — the only rule that needs both a static window and a
+    live measurement, hence emitted here rather than in the pure-HLO
+    rule pass.
     """
     micro_by_op = {
         m["op"]: m
@@ -334,4 +373,24 @@ def attach_measured_costs(
             meas["t_total_s"] = m.get("t_total_s")
         f["measured"] = meas
         n += 1
+    if sched:
+        from ddl25spring_tpu.analysis import sched as sched_mod
+        from ddl25spring_tpu.analysis.rules import h010_finding
+
+        already = {
+            f.get("op") for f in findings
+            if isinstance(f, dict) and f.get("rule") == "H010"
+        }
+        fresh = [
+            h010_finding(strategy, rec)
+            for rec in sched_mod.slack_vs_measured(sched, perf_record)
+            if rec["op"] not in already
+        ]
+        if fresh:
+            waivers_mod.apply_waivers(
+                fresh,
+                waivers_mod.load_waivers() if waivers is None else waivers,
+            )
+            findings.extend(f.to_dict() for f in fresh)
+            n += len(fresh)
     return n

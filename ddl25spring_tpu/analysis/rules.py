@@ -38,6 +38,28 @@ H007      error     collective-permute whose source-target pairs repeat
                     are legal multicast), or a collective grouping over
                     mesh axes the strategy's ``describe()`` signature
                     never declared (axis leak)
+H008      warn      zero/near-zero-slack overlap window: an async
+                    start/done pair with (provably) nothing schedulable
+                    between start and done, or an overlap-declared
+                    strategy's collective with no dataflow-independent
+                    work — the overlap is cosmetic
+                    (:mod:`ddl25spring_tpu.analysis.sched`)
+H009      error     mismatched or reordered collective sequence across
+                    participants: duplicate device in one replica
+                    group, one channel_id shared by sites with
+                    different groups, participants beyond the compiled
+                    device range, conditional branches issuing
+                    divergent collective sequences, crossed async
+                    windows over unequal overlapping groups — the
+                    static deadlock shapes H007's shape-local check
+                    cannot see
+H010      warn      overlap window priced under the measured micro-cost
+                    of the very op it must hide (``runs/perf_ledger.
+                    jsonl``): the schedule cannot hide the transfer
+                    even in principle.  Emitted by
+                    :func:`ddl25spring_tpu.analysis.engine.
+                    attach_measured_costs` when a perf record is in
+                    hand (``graft_lint --perf-ledger``, perfscope)
 ========  ========  ====================================================
 
 Source-level (AST) rules S101-S103 live in
@@ -123,6 +145,10 @@ DEFAULT_THRESHOLDS = {
     # exempt from H001/H007-axis checks — mirrors check_signature's
     # `scalar_bytes`
     "scalar_bytes": 64,
+    # H008: an overlap window whose compute time covers less than this
+    # percentage of the transfer's wire time (reference-chip model) is
+    # cosmetic — the window exists but hides nothing
+    "h008_min_slack_pct": 1,
 }
 
 
@@ -505,3 +531,103 @@ def rule_permute_cycle_and_axis_leak(ctx) -> list[Finding]:
                     ),
                 ))
     return out
+
+
+@hlo_rule("H008")
+def rule_zero_slack_overlap_window(ctx) -> list[Finding]:
+    """An overlap claim with nothing inside the window: an async
+    start/done pair issued back-to-back, or an overlap-declared
+    strategy's collective whose dataflow window holds no independent
+    work.  The transfer serializes exactly as if it were sync — the
+    overlap is cosmetic (the shape H001's has-a-pair test passes
+    trivially)."""
+    sched = getattr(ctx, "sched", None)
+    if not sched:
+        return []
+    thr = ctx.thresholds["h001_sync_bytes"]
+    min_pct = ctx.thresholds.get("h008_min_slack_pct", 1)
+    out = []
+    for rec in sched.get("slack") or []:
+        if rec["window"] not in ("pair", "dataflow"):
+            continue  # a sync schedule window is H001's department
+        moved = max(rec["result_bytes"], rec.get("wire_bytes") or 0)
+        if moved < thr:
+            continue
+        t_wire = rec.get("t_wire_s") or 0.0
+        t_slack = rec.get("t_slack_s") or 0.0
+        if t_wire > 0 and t_slack >= t_wire * (min_pct / 100.0):
+            continue
+        how = (
+            "the start/done pair closes immediately"
+            if rec["window"] == "pair"
+            else "no dataflow-independent work exists to fill it"
+        )
+        out.append(Finding(
+            rule="H008", severity="warn", strategy=ctx.strategy,
+            op=rec.get("op"), bytes=moved,
+            message=(
+                f"{rec['kind']} claims overlap but its window is "
+                f"empty ({how}): slack covers "
+                f"{0.0 if t_wire <= 0 else 100.0 * t_slack / t_wire:.2f}%"
+                f" of the transfer on {sched.get('ref_chip', '?')} — "
+                "the overlap is cosmetic"
+            ),
+            fix_hint=(
+                "move independent compute into the window (issue the "
+                "collective earlier / consume its result later), or "
+                "drop the async/overlap claim so H001 judges it as the "
+                "sync transfer it is"
+            ),
+        ))
+    return out
+
+
+@hlo_rule("H009")
+def rule_participant_stream_mismatch(ctx) -> list[Finding]:
+    """Mismatched or reordered collective sequences across participants
+    — the static deadlock proof.  The evidence comes from the
+    per-participant stream expansion in :mod:`ddl25spring_tpu.analysis.
+    sched` (``check_schedule_safety``); each hazard record is one
+    provable rendezvous that can never complete."""
+    sched = getattr(ctx, "sched", None)
+    if not sched:
+        return []
+    out = []
+    for hz in sched.get("hazards") or []:
+        out.append(Finding(
+            rule="H009", severity="error", strategy=ctx.strategy,
+            op=hz.get("op"),
+            message=f"[{hz['check']}] {hz['message']}",
+            fix_hint=(
+                "make every participant issue the same collective "
+                "sequence with the same groups (check the sharding "
+                "specs and any device-varying control flow feeding "
+                "this op)"
+            ),
+        ))
+    return out
+
+
+def h010_finding(strategy: str | None, rec: dict[str, Any]) -> Finding:
+    """One H010 finding from a :func:`ddl25spring_tpu.analysis.sched.
+    slack_vs_measured` record — the constructor lives here so the rule
+    pack owns every severity/message, while the emission point is
+    :func:`~ddl25spring_tpu.analysis.engine.attach_measured_costs`
+    (the only place a measured perf record is in hand)."""
+    return Finding(
+        rule="H010", severity="warn", strategy=strategy,
+        op=rec.get("op"), bytes=rec.get("result_bytes"),
+        message=(
+            f"{rec['kind']} measured at "
+            f"{rec['t_measured_s'] * 1e3:.3f} ms standalone but its "
+            f"overlap window holds only {rec['t_slack_s'] * 1e3:.3f} ms "
+            f"of independent compute ({rec['slack_flops']:.3g} FLOPs at "
+            "the record's calibrated peak) — the schedule cannot hide "
+            "this transfer even in principle"
+        ),
+        fix_hint=(
+            "grow the window (smaller buckets issued earlier, or more "
+            "compute between issue and use) or shrink the transfer "
+            "(dtype, bucket size) until the measured cost fits"
+        ),
+    )

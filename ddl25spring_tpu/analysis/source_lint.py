@@ -37,12 +37,20 @@ from typing import Iterable
 
 from ddl25spring_tpu.analysis.rules import Finding
 
-# module scopes per rule: path substrings relative to the repo root
+# module scopes per rule: path substrings relative to the repo root.
+# ft/ builds the auto-resume/checkpoint steps that trace on the hot
+# path, and sentinels/perfscope compile guards and micro-benches INTO
+# programs — an env read inside any of them silently forks compiled
+# program structure on ambient process state (PR-9 satellite: scope
+# grown from parallel/+benchmarks to the ft and obs trace surfaces).
 _TRACED_CODE_DIRS = (
     "ddl25spring_tpu/parallel/",
     "ddl25spring_tpu/ops/",
     "ddl25spring_tpu/models/",
     "ddl25spring_tpu/benchmarks.py",
+    "ddl25spring_tpu/ft/",
+    "ddl25spring_tpu/obs/sentinels.py",
+    "ddl25spring_tpu/obs/perfscope.py",
 )
 _DONATE_SCOPE = (
     "ddl25spring_tpu/parallel/",

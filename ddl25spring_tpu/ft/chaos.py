@@ -167,9 +167,12 @@ class ChaosInjector:
     def from_env(
         cls, state_dir: str | os.PathLike | None = None
     ) -> "ChaosInjector":
-        """The driver entry: arm from ``DDL25_CHAOS`` (host-only driver
-        code — trace-time env reads stay behind ``utils.config``)."""
-        return cls(parse_chaos(os.environ.get(CHAOS_ENV)), state_dir)
+        """The driver entry: arm from ``DDL25_CHAOS`` through the
+        sanctioned env boundary (``utils.config.env_str`` — rule S101
+        covers ``ft/`` since PR 9)."""
+        from ddl25spring_tpu.utils.config import env_str
+
+        return cls(parse_chaos(env_str(CHAOS_ENV)), state_dir)
 
     def __bool__(self) -> bool:
         return bool(self.faults)

@@ -27,7 +27,6 @@ sentinel-gated autosave, auto-resume from the latest durable step.
 from __future__ import annotations
 
 import argparse
-import os
 
 
 def main(argv=None) -> int:
@@ -48,9 +47,6 @@ def main(argv=None) -> int:
                          "before the next step (deterministic tests)")
     args = ap.parse_args(argv)
 
-    # the equivalence oracle reuses trees across steps; donation would
-    # invalidate them (same opt-out the test conftest makes)
-    os.environ.setdefault("DDL25_DONATE", "0")
     from ddl25spring_tpu.utils.platform import force_cpu_devices
 
     force_cpu_devices(args.devices)
@@ -84,7 +80,13 @@ def main(argv=None) -> int:
         x, y = batch
         return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
 
-    step = make_dp_train_step(loss_fn, tx, mesh, per_shard_rng=False)
+    # the equivalence oracle reuses trees across steps; donation would
+    # invalidate them — passed explicitly (never via the DDL25_DONATE
+    # env write this driver used to make: S101 forbids traced-module
+    # builds depending on ambient process state)
+    step = make_dp_train_step(
+        loss_fn, tx, mesh, per_shard_rng=False, donate=False
+    )
     step_key = jax.random.PRNGKey(0)
 
     def data_at(data_key, cursor: int):

@@ -552,6 +552,20 @@ def analyze_compiled(
     }
     if meta:
         report["meta"] = meta
+    # whole-program schedule analysis (analysis/sched.py): per-collective
+    # overlap-slack windows, participant-stream safety, and the
+    # per-strategy static_overlap_bound — computed once here and reused
+    # by the lint context, perfscope records, and the report tables;
+    # sched breakage degrades to an error note, never costs the report
+    try:
+        from ddl25spring_tpu.analysis import sched as sched_mod
+
+        report["sched"] = sched_mod.analyze_schedule(
+            hlo_text, mesh, ops=ops,
+            discipline=sched_mod.discipline_of(meta),
+        )
+    except Exception as e:  # noqa: BLE001 — degrade per report
+        report["sched"] = {"error": f"{type(e).__name__}: {e}"}
     return report
 
 

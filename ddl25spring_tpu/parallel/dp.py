@@ -310,6 +310,20 @@ def stack_opt_state(opt_state, n: int):
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + jnp.shape(x)), opt_state)
 
 
+# the bucket threshold describe() defaults to: small enough that the
+# tiny-MLP tree plans MULTIPLE buckets under BOTH packing layouts —
+# the flat grad plan (raw leaf bytes: 128/2048/512 B -> 3 buckets) and
+# ZeRO's per-device row plan (k-row bytes: 32/512/128 B -> 2 buckets,
+# still merging {b1,w1} so the O(buckets) < O(leaves) collapse stays
+# pinned) — so the compile-time reports exercise the real multi-launch
+# structure.  Single-bucket programs cannot show overlap slack (the
+# one collective depends on the whole backward), and the sched
+# verifier's overlap-vs-sync pins need the windows to exist.
+# Deliberately NOT the runtime default (4 MiB) nor the env knob:
+# signatures must not drift with ambient state.
+DESCRIBE_BUCKET_BYTES = 560
+
+
 def _tiny_mlp_workload(n_shards: int):
     """The minimal DP workload the compile-time analytics lower: a 2-layer
     MLP regression step whose gradient tree has a known byte size (shared
@@ -367,9 +381,10 @@ def describe(
     overlap machinery changed what goes on the wire, not just when.
 
     ``bucket_bytes`` pins an explicit threshold (the bucket-sweep
-    harness); the default is :data:`~ddl25spring_tpu.parallel.bucketing.
-    DEFAULT_BUCKET_BYTES` — deliberately NOT the env knob, so compile-
-    time signature pins never drift with ambient ``DDL25_BUCKET_BYTES``.
+    harness); the default is :data:`DESCRIBE_BUCKET_BYTES` — a
+    multi-bucket plan over the tiny tree, deliberately NOT the env
+    knob, so compile-time signature pins never drift with ambient
+    ``DDL25_BUCKET_BYTES``.
     """
     if overlap and not bucketed:
         raise ValueError("overlap describes the bucketed DP path only")
@@ -377,7 +392,7 @@ def describe(
     params, loss_fn, batch, param_bytes = _tiny_mlp_workload(n)
     tx = optax.sgd(0.1)
     bb = (
-        (bucket_bytes or bucketing.DEFAULT_BUCKET_BYTES) if bucketed
+        (bucket_bytes or DESCRIBE_BUCKET_BYTES) if bucketed
         else None
     )
     step = make_dp_train_step(

@@ -1041,10 +1041,18 @@ def describe(
     n = mesh.shape[axis]
     key = jax.random.PRNGKey(0)
     slack = 256
-    bb = (
-        (bucket_bytes or bucketing.DEFAULT_BUCKET_BYTES) if bucketed
-        else None
+    # MLP describes default to the multi-bucket threshold (the sched
+    # verifier's overlap-vs-sync window pins need >= 2 launches; see
+    # dp.DESCRIBE_BUCKET_BYTES); the LLaMA trees keep the runtime
+    # default — their leaf count already exercises the bucketed path
+    from ddl25spring_tpu.parallel.dp import DESCRIBE_BUCKET_BYTES
+
+    default_bb = (
+        bucketing.DEFAULT_BUCKET_BYTES
+        if (prefetch or workload == "llama")
+        else DESCRIBE_BUCKET_BYTES
     )
+    bb = (bucket_bytes or default_bb) if bucketed else None
 
     if prefetch:
         if stage != 3 or not bucketed:

@@ -117,6 +117,18 @@ def env_choice(name: str, choices: tuple[str, ...], default: str) -> str:
     return raw
 
 
+def env_str(name: str, default: str | None = None) -> str | None:
+    """Read a free-form string ``DDL25_*`` setting through the
+    sanctioned env boundary (see :func:`env_flag`).  Unset/empty ->
+    ``default``.  Exists so host-side drivers (``ft.chaos.from_env``)
+    never touch ``os.environ`` from a traced-scope module (rule S101 —
+    the scope grew to ``ft/`` in PR 9)."""
+    import os
+
+    raw = os.environ.get(name)
+    return raw if raw else default
+
+
 def env_float(name: str, default: float) -> float:
     """Read a float ``DDL25_*`` setting through the sanctioned env
     boundary (see :func:`env_flag`).  Unset/empty -> ``default``."""

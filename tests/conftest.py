@@ -40,3 +40,53 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, "conftest failed to fake 8 CPU devices"
     return devs[:8]
+
+
+# ----------------------------------------------- lower-once compile caches
+#
+# Compiles are the suite's wall-clock budget (ROADMAP: ~770 s against an
+# 870 s ceiling on the 2-core CI host).  Every test that needs a
+# registered strategy's compile-time report MUST ride this session cache
+# — one compile per strategy per test session, shared across
+# test_xla_analytics (signature pins), test_hlo_lint (clean baselines),
+# and test_sched (overlap-bound pins).  The generic `lower_once` memo is
+# the same pattern for ad-hoc lowerings (test_health's sentinel-mode
+# HLO texts).
+
+_strategy_reports: dict = {}
+_lowered_once: dict = {}
+
+
+def cached_strategy_report(name: str) -> dict:
+    """Compile + analyze one registered strategy, once per session."""
+    from ddl25spring_tpu.obs import xla_analytics as xa
+
+    if name not in _strategy_reports:
+        _strategy_reports[name] = xa.compile_strategy(name)
+    r = _strategy_reports[name]
+    assert "error" not in r, f"{name} failed to compile: {r.get('error')}"
+    return r
+
+
+@pytest.fixture(scope="session")
+def strategy_report():
+    """The shared compile-once cache, as a fixture: tests call
+    ``strategy_report(name)`` and share one ``compile_strategy`` result
+    per strategy across every test module in the session."""
+    return cached_strategy_report
+
+
+def cached_lowering(key, build):
+    """Generic memoized-lowering cache: runs ``build()`` on first use of
+    ``key`` and replays the result after — for expensive lowerings that
+    aren't registry strategies (e.g. the sentinel-mode HLO texts in
+    test_health)."""
+    if key not in _lowered_once:
+        _lowered_once[key] = build()
+    return _lowered_once[key]
+
+
+@pytest.fixture(scope="session")
+def lower_once():
+    """:func:`cached_lowering`, as a fixture."""
+    return cached_lowering

@@ -246,14 +246,13 @@ def _builder_setups(devices8):
     return setups
 
 
-_LOWERED: dict = {}
-
-
 def _lowered(devices8, name: str, mode: str) -> str:
-    """Lower-once cache over (builder, sentinel-mode) — the
-    test_xla_analytics compile-cache pattern, applied to lowerings."""
-    key = (name, mode)
-    if key not in _LOWERED:
+    """Lower-once cache over (builder, sentinel-mode) — the shared
+    tests/conftest.py memo (one cache for the whole session), applied
+    to lowerings."""
+    from conftest import cached_lowering
+
+    def build_text():
         build = _builder_setups(devices8)[name]
         ctx = {
             "off": sentinels.scoped(False),
@@ -262,8 +261,9 @@ def _lowered(devices8, name: str, mode: str) -> str:
         }[mode]
         with ctx:
             fn, args = build()
-        _LOWERED[key] = fn.lower(*args).as_text()
-    return _LOWERED[key]
+        return fn.lower(*args).as_text()
+
+    return cached_lowering(("health-lowered", name, mode), build_text)
 
 
 def test_every_builder_hlo_identical_when_disabled(devices8):

@@ -6,14 +6,17 @@ Two contracts pinned here:
    synthetic HLO module (and each S-rule one pitfall Python snippet)
    proving the rule detects what it claims, plus a near-miss showing it
    stays quiet when the hazard is absent.
-2. **Every strategy is clean** — all ten registered parallel strategies
-   compile with ZERO unwaived findings on this jax, the same way PR 2
-   pinned their collective signatures.  A refactor that introduces a
-   sync-collective pileup, a donation miss, or an axis leak fails here
-   (and the ``graft-lint`` CI job) before it ever reaches a TPU.
+2. **Every strategy is clean** — all fourteen registered parallel
+   strategies compile with ZERO unwaived findings on this jax, the same
+   way PR 2 pinned their collective signatures.  A refactor that
+   introduces a sync-collective pileup, a donation miss, an axis leak,
+   or a participant-stream mismatch fails here (and the ``graft-lint``
+   CI job) before it ever reaches a TPU.
 
-The strategy compiles are shared with ``tests/test_xla_analytics.py``'s
-module-level report cache — one compile per strategy per test session.
+The strategy compiles ride the shared session cache in
+``tests/conftest.py`` — one compile per strategy per test session,
+shared with test_xla_analytics's signature pins and test_sched's
+overlap-bound pins.
 """
 
 import json
@@ -30,7 +33,7 @@ from ddl25spring_tpu.analysis.rules import (
 from ddl25spring_tpu.analysis.waivers import apply_waivers, load_waivers
 from ddl25spring_tpu.obs.compile_report import DEFAULT_STRATEGIES
 from ddl25spring_tpu.utils.mesh import make_mesh
-from test_xla_analytics import _report  # shared compile-once cache
+from conftest import cached_strategy_report as _report  # lower-once cache
 
 
 def _rules_fired(findings):
@@ -400,6 +403,207 @@ def test_h007_axis_leak_against_declared_signature(mesh22):
     assert "H007" not in _rules_fired(_lint(H007_AXIS_LEAK, mesh=mesh22))
 
 
+# ------------------------------------------ sched rule pack (H008-H010)
+
+# 4 MiB async pair closed immediately: the cosmetic-overlap shape the
+# PR-9 motivation names — H001's has-a-pair test passes it trivially,
+# H008 must not
+H008_ZERO_SLACK_PAIR = f"""\
+HloModule h008
+{_ADD}
+ENTRY %main (x: f32[1048576], a: f32[512,512], b: f32[512,512]) -> f32[1048576] {{
+  %x = f32[1048576]{{0}} parameter(0)
+  %a = f32[512,512]{{1,0}} parameter(1)
+  %b = f32[512,512]{{1,0}} parameter(2)
+  %ars = f32[1048576]{{0}} all-reduce-start(f32[1048576]{{0}} %x), replica_groups={{{{0,1,2,3}}}}, to_apply=%add
+  %ard = f32[1048576]{{0}} all-reduce-done(f32[1048576]{{0}} %ars)
+  %d = f32[512,512]{{1,0}} dot(f32[512,512]{{1,0}} %a, f32[512,512]{{1,0}} %b), lhs_contracting_dims={{1}}, rhs_contracting_dims={{0}}
+  ROOT %out = f32[1048576]{{0}} add(f32[1048576]{{0}} %ard, f32[1048576]{{0}} %ard)
+}}
+"""
+
+
+def test_h008_zero_slack_async_pair_fires():
+    fs = _lint(H008_ZERO_SLACK_PAIR)
+    f = next(f for f in fs if f.rule == "H008")
+    assert f.severity == "warn"
+    assert "cosmetic" in f.message
+    # H001 is satisfied by the pair — exactly the blind spot H008 covers
+    assert "H001" not in _rules_fired(fs)
+
+
+def test_h008_near_miss_pair_with_real_window_is_quiet():
+    # the same pair with the 2*512^3-FLOP dot INSIDE the window (above
+    # 1% of the transfer's wire time on the reference chip): overlapped
+    # for real, H008 stays quiet
+    moved = H008_ZERO_SLACK_PAIR.replace(
+        "  %ard = f32[1048576]{0} all-reduce-done(f32[1048576]{0} %ars)\n"
+        "  %d = f32[512,512]{1,0} dot(f32[512,512]{1,0} %a, "
+        "f32[512,512]{1,0} %b), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n",
+        "  %d = f32[512,512]{1,0} dot(f32[512,512]{1,0} %a, "
+        "f32[512,512]{1,0} %b), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n"
+        "  %ard = f32[1048576]{0} all-reduce-done(f32[1048576]{0} %ars)\n",
+    )
+    assert "H008" not in _rules_fired(_lint(moved))
+    # below the byte threshold nothing fires either way
+    small = H008_ZERO_SLACK_PAIR.replace("1048576]", "1024]")
+    assert "H008" not in _rules_fired(_lint(small))
+
+
+def test_h008_judges_overlap_declared_sync_collectives_too():
+    """An overlap-DECLARED strategy (describe meta overlap=True) whose
+    big sync collective has no dataflow-independent work is the same
+    cosmetic claim without the async spelling — H008 fires; give the
+    window real independent compute and it clears."""
+    sync_big = f"""\
+HloModule h008b
+{_ADD}
+ENTRY %main (x: f32[1048576], a: f32[512,512], b: f32[512,512]) -> f32[1048576] {{
+  %x = f32[1048576]{{0}} parameter(0)
+  %a = f32[512,512]{{1,0}} parameter(1)
+  %b = f32[512,512]{{1,0}} parameter(2)
+  %ar = f32[1048576]{{0}} all-reduce(f32[1048576]{{0}} %x), replica_groups={{{{0,1,2,3}}}}, to_apply=%add
+  ROOT %out = f32[1048576]{{0}} negate(f32[1048576]{{0}} %ar)
+}}
+"""
+    report = {"meta": {"overlap": True}}
+    fs = _lint(sync_big, report=report)
+    assert any(f.rule == "H008" and "no dataflow-independent" in f.message
+               for f in fs)
+    # the dot is independent of the all-reduce: a real dataflow window
+    with_dot = sync_big.replace(
+        "ROOT %out = f32[1048576]{0} negate(f32[1048576]{0} %ar)",
+        "%d = f32[512,512]{1,0} dot(f32[512,512]{1,0} %a, "
+        "f32[512,512]{1,0} %b), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n"
+        "  ROOT %out = f32[1048576]{0} negate(f32[1048576]{0} %ar)",
+    )
+    assert "H008" not in _rules_fired(_lint(with_dot, report=report))
+    # without the overlap declaration the sync op is H001's department
+    assert "H008" not in _rules_fired(_lint(sync_big))
+
+
+# two sites share channel 7 but group the mesh differently: every
+# participant waits on a peer set that never assembles — the
+# mismatched-participant deadlock H007 (shape-local: duplicate permute
+# targets, axis leaks) cannot catch
+H009_CHANNEL_MISMATCH = f"""\
+HloModule h009, num_partitions=4
+{_ADD}
+ENTRY %main (x: f32[1024], y: f32[1024]) -> f32[1024] {{
+  %x = f32[1024]{{0}} parameter(0)
+  %y = f32[1024]{{0}} parameter(1)
+  %ar1 = f32[1024]{{0}} all-reduce(f32[1024]{{0}} %x), channel_id=7, replica_groups={{{{0,1}},{{2,3}}}}, use_global_device_ids=true, to_apply=%add
+  %ar2 = f32[1024]{{0}} all-reduce(f32[1024]{{0}} %y), channel_id=7, replica_groups={{{{0,2}},{{1,3}}}}, use_global_device_ids=true, to_apply=%add
+  ROOT %s = f32[1024]{{0}} add(f32[1024]{{0}} %ar1, f32[1024]{{0}} %ar2)
+}}
+"""
+
+
+def test_h009_mismatched_participants_deadlock_h007_cannot_catch():
+    fs = _lint(H009_CHANNEL_MISMATCH)
+    f = next(f for f in fs if f.rule == "H009")
+    assert f.severity == "error"
+    assert "channel-group-mismatch" in f.message
+    # H007's shape-local checks see nothing wrong with either site
+    assert "H007" not in _rules_fired(fs)
+    # near miss: same channel, same groups — two instances of one
+    # rendezvous shape, perfectly legal
+    ok = H009_CHANNEL_MISMATCH.replace("{{0,2},{1,3}}", "{{0,1},{2,3}}")
+    assert "H009" not in _rules_fired(_lint(ok))
+
+
+def test_h009_divergent_conditional_sequences():
+    hlo = f"""\
+HloModule h009b
+{_ADD}
+%true_b (t: f32[256]) -> f32[256] {{
+  %t = f32[256]{{0}} parameter(0)
+  ROOT %ar = f32[256]{{0}} all-reduce(f32[256]{{0}} %t), replica_groups={{{{0,1,2,3}}}}, to_apply=%add
+}}
+%false_b (f: f32[256]) -> f32[256] {{
+  %f = f32[256]{{0}} parameter(0)
+  ROOT %n = f32[256]{{0}} negate(f32[256]{{0}} %f)
+}}
+ENTRY %main (p: pred[], x: f32[256]) -> f32[256] {{
+  %p = pred[] parameter(0)
+  %x = f32[256]{{0}} parameter(1)
+  ROOT %c = f32[256]{{0}} conditional(pred[] %p, f32[256]{{0}} %x, f32[256]{{0}} %x), true_computation=%true_b, false_computation=%false_b
+}}
+"""
+    fs = _lint(hlo)
+    assert any(f.rule == "H009" and "divergent-branches" in f.message
+               for f in fs)
+    same = hlo.replace(
+        "ROOT %n = f32[256]{0} negate(f32[256]{0} %f)",
+        "ROOT %n = f32[256]{0} all-reduce(f32[256]{0} %f), "
+        "replica_groups={{0,1,2,3}}, to_apply=%add",
+    )
+    assert "H009" not in _rules_fired(_lint(same))
+
+
+def test_h010_prices_windows_against_measured_micro_costs():
+    """H010 rides attach_measured_costs (the only place a static window
+    and a live measurement meet): a window whose compute cannot cover
+    the op's measured standalone cost fires; a window that can stays
+    quiet."""
+    from ddl25spring_tpu.analysis import sched as sched_mod
+
+    zero = sched_mod.analyze_schedule(H008_ZERO_SLACK_PAIR)
+    record = {
+        "peak_flops_per_chip": 1e12,
+        "micro": [{"op": "ars", "t_s": 1e-3}],
+    }
+    findings: list = []
+    n = engine.attach_measured_costs(
+        findings, record, sched=zero, strategy="synthetic", waivers=[]
+    )
+    assert n == 1
+    (f,) = findings
+    assert f["rule"] == "H010" and f["severity"] == "warn"
+    assert "even in principle" in f["message"]
+    assert not f["waived"]
+    # near miss: the paired-with-dot window holds ~268 us of compute at
+    # this peak — a 100 us measured transfer hides, no finding
+    hlo_ok = H008_ZERO_SLACK_PAIR.replace(
+        "  %ard = f32[1048576]{0} all-reduce-done(f32[1048576]{0} %ars)\n"
+        "  %d = f32[512,512]{1,0} dot(f32[512,512]{1,0} %a, "
+        "f32[512,512]{1,0} %b), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n",
+        "  %d = f32[512,512]{1,0} dot(f32[512,512]{1,0} %a, "
+        "f32[512,512]{1,0} %b), lhs_contracting_dims={1}, "
+        "rhs_contracting_dims={0}\n"
+        "  %ard = f32[1048576]{0} all-reduce-done(f32[1048576]{0} %ars)\n",
+    )
+    ok = sched_mod.analyze_schedule(hlo_ok)
+    fs2: list = []
+    engine.attach_measured_costs(
+        fs2, {"peak_flops_per_chip": 1e12,
+              "micro": [{"op": "ars", "t_s": 100e-6}]},
+        sched=ok, strategy="synthetic", waivers=[],
+    )
+    assert [f["rule"] for f in fs2] == []
+
+
+def test_h010_findings_resolve_against_waivers():
+    from ddl25spring_tpu.analysis import sched as sched_mod
+    from ddl25spring_tpu.analysis.waivers import Waiver
+
+    zero = sched_mod.analyze_schedule(H008_ZERO_SLACK_PAIR)
+    record = {"peak_flops_per_chip": 1e12,
+              "micro": [{"op": "ars", "t_s": 1e-3}]}
+    findings: list = []
+    engine.attach_measured_costs(
+        findings, record, sched=zero, strategy="dp-overlap",
+        waivers=[Waiver(rule="H010", strategy="dp-*",
+                        reason="fake mesh: micro costs are dispatch-bound")],
+    )
+    assert findings and findings[0]["waived"]
+    assert "dispatch-bound" in findings[0]["waived_reason"]
+
+
 # ------------------------------------------------------- source rule pack
 
 S101_SRC = """\
@@ -552,6 +756,94 @@ def test_mini_parser_rejects_trailing_junk_but_takes_comments():
     assert ok["waiver"][0] == {"rule": "H001", "reason": "r"}
     with pytest.raises(ValueError, match="after string value"):
         _parse_mini('[[waiver]]\nrule = "H001" strategy = "dp"\n')
+
+
+def _tomllib():
+    try:
+        import tomllib
+
+        return tomllib
+    except ModuleNotFoundError:  # the 3.10 image: fallback only
+        return None
+
+
+def test_mini_parser_matches_tomllib_on_escaped_quotes():
+    """The fallback parser is load-bearing on the 3.10 build image —
+    every construct the schema allows must parse IDENTICALLY to
+    tomllib (checked directly on 3.11 CI, pinned by value here)."""
+    from ddl25spring_tpu.analysis.waivers import _parse_mini
+
+    text = (
+        '[[waiver]]\n'
+        'rule = "H001"\n'
+        'match = "say \\"sync\\" twice"\n'
+        'reason = "quoted \\"reason\\" with a # inside"\n'
+    )
+    mini = _parse_mini(text)
+    assert mini["waiver"][0]["match"] == 'say "sync" twice'
+    assert mini["waiver"][0]["reason"] == 'quoted "reason" with a # inside'
+    tl = _tomllib()
+    if tl is not None:
+        assert mini == tl.loads(text)
+
+
+def test_mini_parser_matches_tomllib_on_crlf_line_endings():
+    """A waivers.toml saved with CRLF endings (Windows checkout, or a
+    heredoc through a CR-preserving pipe) must parse identically —
+    the \\r must never leak into a rule id or reason string."""
+    from ddl25spring_tpu.analysis.waivers import _parse_mini
+
+    text = (
+        '[[waiver]]\r\n'
+        'rule = "H005"\r\n'
+        'reason = "crlf file"\r\n'
+        '\r\n'
+        '[[waiver]]\r\n'
+        'rule = "H001"\r\n'
+        'reason = "second entry"\r\n'
+    )
+    mini = _parse_mini(text)
+    assert [w["rule"] for w in mini["waiver"]] == ["H005", "H001"]
+    assert mini["waiver"][0]["reason"] == "crlf file"
+    tl = _tomllib()
+    if tl is not None:
+        assert mini == tl.loads(text)
+
+
+def test_mini_parser_rejects_table_of_tables_loudly():
+    """tomllib accepts plain/nested tables (``[waiver]``,
+    ``[waiver.meta]``); the mini parser supports exactly the
+    array-of-tables schema and must REJECT anything else loudly —
+    silently ignoring a section tomllib would honor is how the two
+    parsers diverge into a waiver that works on CI (3.11) and not on
+    the build image (3.10)."""
+    from ddl25spring_tpu.analysis.waivers import _parse_mini
+
+    for text in (
+        '[waiver]\nrule = "H001"\nreason = "r"\n',
+        '[[waiver]]\nrule = "H001"\nreason = "r"\n[waiver.meta]\nx = "y"\n',
+    ):
+        tl = _tomllib()
+        if tl is not None:
+            tl.loads(text)  # tomllib is fine with it — the divergence
+        with pytest.raises(ValueError, match="only \\[\\[table\\]\\]"):
+            _parse_mini(text)
+
+
+def test_load_waivers_reads_crlf_and_escaped_quotes_from_disk(tmp_path):
+    """End-to-end through load_waivers: binary-written CRLF bytes and
+    escaped quotes survive the open()/parse path on any Python."""
+    p = tmp_path / "w.toml"
+    p.write_bytes(
+        b'[[waiver]]\r\n'
+        b'rule = "S102"\r\n'
+        b'symbol = "make_\\"odd\\"_step"\r\n'
+        b'reason = "windows checkout"\r\n'
+    )
+    (w,) = load_waivers(str(p))
+    assert w.rule == "S102"
+    assert w.symbol == 'make_"odd"_step'
+    assert w.reason == "windows checkout"
 
 
 def test_repo_waiver_file_loads_and_every_entry_has_reason():

@@ -285,12 +285,25 @@ def test_dp_record_carries_bucket_knob_fields():
     """Sweep comparability: every strategy record names the bucket
     threshold + plan it measured (the DDL25_BUCKET_BYTES knob's value
     at build time) so grid points and env-knob runs never mix
-    silently."""
-    from ddl25spring_tpu.parallel import bucketing
+    silently.  Since PR 9 the describe() default is the multi-bucket
+    DESCRIBE_BUCKET_BYTES (the sched verifier's overlap windows need
+    >= 2 launches to exist), not the 4 MiB runtime default."""
+    from ddl25spring_tpu.parallel import dp
 
     rec = _dp_record()
-    assert rec["bucket_bytes"] == bucketing.DEFAULT_BUCKET_BYTES
-    assert rec["n_buckets"] == 1
+    assert rec["bucket_bytes"] == dp.DESCRIBE_BUCKET_BYTES
+    assert rec["n_buckets"] == 3
+
+
+def test_record_carries_static_overlap_bound():
+    """PR-9 wiring: every measured record ships the schedule verifier's
+    analytical overlap ceiling next to the measured overlap_eff — dp is
+    a sync-issue strategy, so its committed schedule provably allows
+    (essentially) nothing, and the bound says so deterministically."""
+    rec = _dp_record()
+    assert "static_overlap_bound" in rec
+    assert rec["static_overlap_bound"] == 0.0
+    assert "static_overlap_bound" in perfscope.perf_cell(rec)
 
 
 def test_bucket_sweep_measures_grid_and_recommends(tmp_path):
@@ -377,6 +390,39 @@ def test_perf_report_table_renders(tmp_path, capsys):
     assert perf_report.main(["--ledger", led]) == 0
     out = capsys.readouterr().out
     assert "strategy dp" in out and "step p50" in out and "MFU" in out
+
+
+def test_perf_report_format_json_is_machine_readable(tmp_path, capsys):
+    """PR-9 satellite: --format json mirrors graft_lint --format json —
+    one structured document carrying the grouped records AND every
+    check verdict, so CI parses instead of grepping stderr tables."""
+    import tools.perf_report as perf_report
+
+    led = str(tmp_path / "ledger.jsonl")
+    base = _dp_record()
+    perfscope.append_ledger(base, led)
+    slow = dict(base, step_s_p50=base["step_s_p50"] * 50, ts=base["ts"] + 1)
+    perfscope.append_ledger(slow, led)
+
+    assert perf_report.main(["--ledger", led, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["record"] == "perf_report"
+    (g,) = doc["groups"]
+    assert g["strategy"] == "dp" and len(g["records"]) == 2
+    # the 50x regression verdict rides the document
+    assert doc["check"]["ok"] is False and doc["check"]["fails"] == 1
+    assert any("step_s_p50" in f for f in g["fails"])
+
+    # --check still gates on the same shared verdicts
+    assert perf_report.main(
+        ["--ledger", led, "--format", "json", "--check"]
+    ) == 1
+    out = capsys.readouterr()
+    assert json.loads(out.out)["check"]["fails"] == 1
+    assert "CHECK FAIL" in out.err
+    # legacy --json spelling stays an alias
+    assert perf_report.main(["--ledger", led, "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["record"] == "perf_report"
 
 
 def test_obs_report_renders_performance_section(tmp_path, capsys):

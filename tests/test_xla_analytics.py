@@ -247,17 +247,10 @@ def test_compiled_flops_rides_the_shared_compat_path():
 
 # ------------------------------------------------- strategy signature pins
 
-_REPORTS: dict = {}
-
-
-def _report(name: str) -> dict:
-    """Compile-once cache: each strategy's report is built on first use
-    and shared across the pins below (compiles are the slow part)."""
-    if name not in _REPORTS:
-        _REPORTS[name] = xa.compile_strategy(name)
-    r = _REPORTS[name]
-    assert "error" not in r, f"{name} failed to compile: {r.get('error')}"
-    return r
+# the compile-once cache moved to tests/conftest.py (PR 9): one
+# compile_strategy() per strategy per SESSION, shared with
+# test_hlo_lint's clean baselines and test_sched's overlap-bound pins
+from conftest import cached_strategy_report as _report  # noqa: E402
 
 
 def _count(r: dict, kind: str) -> int:

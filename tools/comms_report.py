@@ -98,6 +98,7 @@ def format_strategy_report(r: dict) -> str:
             f"  projected on {chip}: step {p['projected_step_s'] * 1e6:.1f} us "
             f"({p['bound']}-bound), MFU {p['projected_mfu']:.3f}"
         )
+    lines.append("  " + _sched_cell(r))
     viols = r.get("signature_violations")
     if viols:
         lines.append("  SIGNATURE VIOLATIONS:")
@@ -107,6 +108,37 @@ def format_strategy_report(r: dict) -> str:
                      "collective signature)")
     lines.append("  " + _findings_cell(r))
     return "\n".join(lines)
+
+
+def _sched_cell(r: dict) -> str:
+    """The static-schedule column: the analytical overlap ceiling +
+    window accounting from the sched verifier (analysis/sched.py) —
+    the per-strategy slack the noise-bound wall-clock A/B cannot
+    resolve."""
+    s = r.get("sched")
+    if not s:
+        return "sched: not analyzed"
+    if s.get("error"):
+        return f"sched: analysis degraded ({s['error']})"
+    bound = s.get("static_overlap_bound")
+    scalar = s.get("scalar_bytes", 64)
+    windows = [
+        w for w in s.get("slack") or [] if w["result_bytes"] > scalar
+    ]
+    slack_flops = sum(w["slack_flops"] for w in windows)
+    cell = (
+        "sched: no non-scalar collectives to overlap" if not windows
+        else (
+            f"sched: static overlap bound "
+            f"{bound:.4f} on {s.get('ref_chip', '?')} "
+            f"({s.get('discipline')} issue, {len(windows)} window(s), "
+            f"{slack_flops:.3g} independent FLOPs)"
+        )
+    )
+    hz = s.get("hazards") or []
+    if hz:
+        cell += f"  DEADLOCK HAZARDS: {len(hz)} — see graft_lint H009"
+    return cell
 
 
 def _findings_cell(r: dict) -> str:

@@ -70,7 +70,40 @@ def _fmt_finding(f: dict) -> str:
     return line
 
 
-def _render_table(src_findings, hlo_reports) -> str:
+def _fmt_sched(r: dict) -> list[str]:
+    """The --sched block for one strategy: per-window slack + the
+    static overlap bound (analysis/sched.py)."""
+    s = r.get("sched")
+    if not s:
+        return ["  sched: not analyzed"]
+    if s.get("error"):
+        return [f"  sched: analysis degraded ({s['error']})"]
+    bound = s.get("static_overlap_bound")
+    lines = [
+        "  sched: "
+        + (
+            f"static overlap bound {bound:.4f}" if bound is not None
+            else "no non-scalar collectives"
+        )
+        + f"  [{s.get('discipline')} issue discipline, "
+        f"ref {s.get('ref_chip', '?')}, "
+        f"{s.get('async_pairs', 0)} async pair(s), "
+        f"{len(s.get('hazards') or [])} deadlock hazard(s)]"
+    ]
+    for w in s.get("slack") or []:
+        if w["result_bytes"] <= s.get("scalar_bytes", 64):
+            continue  # scalar bookkeeping: never judged
+        lines.append(
+            f"    {w['op']} {w['kind']} x{w['count']} "
+            f"[{w['window']} window] slack {w['slack_flops']:.3g} FLOPs "
+            f"/ {w['slack_bytes']} B over "
+            f"{w['independent_instructions']} instr(s), "
+            f"wire {w['wire_bytes']} B"
+        )
+    return lines
+
+
+def _render_table(src_findings, hlo_reports, sched: bool = False) -> str:
     from ddl25spring_tpu.analysis.engine import summarize
 
     blocks = []
@@ -95,6 +128,8 @@ def _render_table(src_findings, hlo_reports) -> str:
         if r.get("lint_error"):
             head += f"  [lint degraded: {r['lint_error']}]"
         blocks.append(head)
+        if sched:
+            blocks.extend(_fmt_sched(r))
         blocks.extend(_fmt_finding(f) for f in fs)
     return "\n".join(blocks)
 
@@ -117,7 +152,14 @@ def main(argv=None) -> int:
     ap.add_argument("--format", choices=("table", "json"), default="table")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero on any unwaived finding or "
-                         "compile failure (the CI gate)")
+                         "compile failure (the CI gate; implies --sched)")
+    ap.add_argument("--sched", action="store_true",
+                    help="render the whole-program schedule report per "
+                         "strategy: overlap-slack windows, the static "
+                         "overlap bound, and deadlock-hazard counts "
+                         "(analysis/sched.py).  The H008-H010 rules run "
+                         "regardless; this flag controls the report "
+                         "detail.  On by default under --check")
     ap.add_argument("--no-src", action="store_true",
                     help="skip the source (AST) pass")
     ap.add_argument("--waivers", default=None, metavar="TOML",
@@ -196,8 +238,14 @@ def main(argv=None) -> int:
                     latest[(rec.get("strategy"), str(rec.get("mesh")))] = rec
             for name, r in hlo_reports.items():
                 rec = latest.get((name, str(r.get("mesh"))))
-                if rec and r.get("findings"):
-                    attach_measured_costs(r["findings"], rec)
+                if rec and r.get("findings") is not None:
+                    # prices H001 findings AND the schedule's overlap
+                    # windows — windows that cannot hide their own
+                    # measured transfer surface as H010 findings here
+                    attach_measured_costs(
+                        r["findings"], rec, sched=r.get("sched"),
+                        strategy=name, waivers=waivers,
+                    )
 
     if args.format == "json":
         doc = {
@@ -207,7 +255,9 @@ def main(argv=None) -> int:
         }
         print(json.dumps(doc, indent=1, default=str))
     else:
-        print(_render_table(src_findings, hlo_reports))
+        print(_render_table(
+            src_findings, hlo_reports, sched=args.sched or args.check
+        ))
 
     if args.check:
         bad = 0

@@ -5,7 +5,7 @@ regressions.
     python tools/perf_report.py --strategy dp --last 10
     python tools/perf_report.py --check               # the CI gate
     python tools/perf_report.py --check --tolerance 1.0   # wide CI band
-    python tools/perf_report.py --json
+    python tools/perf_report.py --format json         # machine-readable
 
 The ledger (``runs/perf_ledger.jsonl``, written by
 ``python -m ddl25spring_tpu.obs.perfscope`` and by ``bench.py``) holds
@@ -203,8 +203,13 @@ def main(argv=None) -> int:
                     help="fractional regression band (0.35 = step may "
                          "grow 35%%, MFU may drop 35%%); CI machines "
                          "want wide bands (e.g. 1.0)")
+    ap.add_argument("--format", choices=("table", "json"), default="table",
+                    help="json mirrors graft_lint --format json: one "
+                         "structured document carrying the grouped "
+                         "records AND every check verdict, so CI jobs "
+                         "parse instead of grepping the table")
     ap.add_argument("--json", action="store_true",
-                    help="print the grouped records as JSON")
+                    help="deprecated alias for --format json")
     ap.add_argument("--check", action="store_true",
                     help="exit non-zero when any key's latest record "
                          "regresses past the band (the CI perf gate)")
@@ -229,11 +234,45 @@ def main(argv=None) -> int:
         records = [r for r in records if r.get("strategy") in wanted]
 
     groups = group_records(records)
-    if args.json:
-        print(json.dumps(
-            {"|".join(k): v for k, v in groups.items()},
-            indent=1, default=str,
-        ))
+    # one verdict pass shared by the json document and the --check
+    # gate: CI parses verdicts out of the JSON instead of grepping
+    # "CHECK FAIL" lines off stderr
+    verdicts: dict[tuple, dict] = {}
+    for key, recs in groups.items():
+        fails: list[str] = []
+        note = None
+        if args.min_overlap_eff is not None:
+            # the absolute floor gates even a single fresh record
+            fails += check_overlap_floor(recs, args.min_overlap_eff)
+        if len(recs) < 2:
+            if not fails:
+                note = "no baseline yet (single record)"
+        else:
+            fails += check_group(recs, args.tolerance, args.window)
+        verdicts[key] = {"fails": fails, "note": note}
+    bad = sum(len(v["fails"]) for v in verdicts.values())
+
+    if args.json or args.format == "json":
+        doc = {
+            "record": "perf_report",
+            "ledger": args.ledger,
+            "tolerance": args.tolerance,
+            "window": args.window,
+            "min_overlap_eff": args.min_overlap_eff,
+            "groups": [
+                {
+                    "strategy": key[0],
+                    "mesh": key[1],
+                    "host": key[2],
+                    "records": recs[-args.last:],
+                    "fails": verdicts[key]["fails"],
+                    "note": verdicts[key]["note"],
+                }
+                for key, recs in groups.items()
+            ],
+            "check": {"ok": bad == 0, "fails": bad},
+        }
+        print(json.dumps(doc, indent=1, default=str))
     else:
         print(f"perf ledger: {args.ledger}  ({len(records)} record(s), "
               f"{len(groups)} key(s))\n")
@@ -242,22 +281,12 @@ def main(argv=None) -> int:
         ))
 
     if args.check:
-        bad = 0
-        for key, recs in groups.items():
+        for key, v in verdicts.items():
             label = f"{key[0]} mesh({key[1]})"
-            fails: list[str] = []
-            if args.min_overlap_eff is not None:
-                # the absolute floor gates even a single fresh record
-                fails += check_overlap_floor(recs, args.min_overlap_eff)
-            if len(recs) < 2:
-                if not fails:
-                    print(f"CHECK NOTE {label}: no baseline yet "
-                          "(single record)", file=sys.stderr)
-            else:
-                fails += check_group(recs, args.tolerance, args.window)
-            for fail in fails:
+            if v["note"]:
+                print(f"CHECK NOTE {label}: {v['note']}", file=sys.stderr)
+            for fail in v["fails"]:
                 print(f"CHECK FAIL {label}: {fail}", file=sys.stderr)
-                bad += 1
         if bad:
             return 1
         floor = (
