@@ -588,6 +588,36 @@ def main(argv=None) -> None:
                     help="CPU smoke run with telemetry: single-device DP, "
                          "tiny dataset/steps, no FedAvg; writes "
                          "--obs-dir (default runs/bench_smoke)")
+    # --- serving mode (ddl25spring_tpu/serve): the inference bench -----
+    ap.add_argument("--serve", action="store_true",
+                    help="run the continuous-batching LLaMA serving bench "
+                         "instead of the training bench: seeded open-loop "
+                         "traffic through the paged-KV decode engine, "
+                         "BENCH line with telemetry.serve (tokens/sec/"
+                         "chip, TTFT + per-token p50/p95, admission "
+                         "counters, pool occupancy) and a continuous-vs-"
+                         "static A/B in the perf ledger; with --smoke: "
+                         "tiny fp32 model, CPU, obs-dir runs/serve_smoke. "
+                         "Engine knobs via DDL25_SERVE_* (see README)")
+    ap.add_argument("--serve-duration", type=float, default=None,
+                    metavar="S", help="traffic trace duration (seconds of "
+                                      "arrival clock)")
+    ap.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
+                    help="peak arrival rate (requests/sec)")
+    ap.add_argument("--serve-profile", default=None,
+                    choices=("flat", "ramp", "spike"),
+                    help="arrival-rate shape (default ramp)")
+    ap.add_argument("--serve-seed", type=int, default=None,
+                    help="traffic trace seed (two runs on the same seed "
+                         "replay the identical workload)")
+    ap.add_argument("--serve-budget", type=float, default=None, metavar="S",
+                    help="wall-clock bound on the ramp phase (default: "
+                         "run to drain)")
+    ap.add_argument("--serve-model", default=None, choices=("tiny", "ref"),
+                    help="model to serve (default: tiny under --smoke, "
+                         "else the reference LLaMA constants)")
+    ap.add_argument("--no-serve-ab", action="store_true",
+                    help="skip the continuous-vs-static A/B phase")
     ap.add_argument("--compile-report", action="store_true",
                     help="force the pre-device compile report on CPU runs "
                          "(the accelerator path always computes it; see "
@@ -602,6 +632,10 @@ def main(argv=None) -> None:
         print(f"clamping --attempts {args.attempts} -> 1", file=sys.stderr)
         args.attempts = 1
 
+    if args.serve and args.smoke:
+        # the serving smoke gets its own obs dir so a bench smoke and a
+        # serve smoke in one CI run never clobber each other's artifacts
+        args.obs_dir = args.obs_dir or os.path.join("runs", "serve_smoke")
     if args.smoke:
         args.cpu = True
         args.no_fedavg = True
@@ -741,6 +775,58 @@ def main(argv=None) -> None:
         # mode.  Everything worth persisting is flushed; exit hard.
         if "timed out" in str(err):
             os._exit(0)
+        return
+
+    # --- serving mode: traffic -> paged-KV engine -> telemetry.serve ---
+    # (the training phases below never run; the serve driver owns the
+    # ramp, the continuous-vs-static A/B, serve.json, and the ledger row)
+    if args.serve:
+        from ddl25spring_tpu.obs import sentinels as _sentinels
+        from ddl25spring_tpu.serve.driver import run_serve_bench, serve_cell
+
+        record = run_serve_bench(
+            smoke=args.smoke,
+            model=args.serve_model,
+            obs_dir=args.obs_dir,
+            duration_s=args.serve_duration,
+            rate_rps=args.serve_rate,
+            profile=args.serve_profile,
+            seed=args.serve_seed,
+            budget_s=args.serve_budget,
+            ledger_path=args.perf_ledger or "runs/perf_ledger.jsonl",
+            skip_ab=args.no_serve_ab,
+        )
+        telemetry: dict = {
+            "enabled": bool(args.obs_dir),
+            "serve": serve_cell(record),
+        }
+        if compile_report is not None:
+            telemetry["compile_report"] = compile_report
+            telemetry["lint"] = lint_summary(compile_report)
+        snap = flight.snapshot()
+        health = {
+            "sentinels": _sentinels.enabled(),
+            "policy": _sentinels.policy(),
+            "violations": snap["violations"],
+            "stalls": snap["stalls"],
+            "flight_records": snap["recorded"],
+        }
+        if args.obs_dir:
+            health["flight_dump"] = flight.dump(reason="end_of_run")
+        telemetry["health"] = health
+        ramp = record["ramp"]
+        print(json.dumps({
+            "metric": "serve_tokens_per_sec_per_chip",
+            "value": ramp.get("tokens_per_sec_per_chip"),
+            "unit": "tokens/sec/chip",
+            # no committed serving baseline yet: the perf ledger trend
+            # (tools/serve_report.py --check) is the regression gate
+            "vs_baseline": None,
+            "model": record["key"]["model"],
+            "profile": record["key"]["profile"],
+            "chip": f"{devices[0].device_kind} x{ramp.get('n_chips', 1)}",
+            "telemetry": telemetry,
+        }), flush=True)
         return
 
     import time

@@ -43,14 +43,36 @@ from ddl25spring_tpu.utils.config import LlamaConfig
 Params = dict[str, Any]
 
 
+def resolve_heads(cfg: LlamaConfig, num_heads: int | None) -> int:
+    """The per-shard head count a KV cache is shaped with: ``num_heads``
+    overrides the config for TP decode (each shard caches only its
+    local ``H/t`` heads).
+
+    An explicit non-positive override raises instead of silently
+    falling back to ``cfg.num_heads`` — the ``num_heads or
+    cfg.num_heads`` idiom treated ``num_heads=0`` as *unset* and would
+    mis-shape the cache.  Shared by both cache layouts (the dense slab
+    below and :mod:`ddl25spring_tpu.serve.kv_pages`' page pool), so
+    they validate identically."""
+    if num_heads is None:
+        return cfg.num_heads
+    if num_heads <= 0:
+        raise ValueError(
+            f"num_heads={num_heads}: a head-count override must be a "
+            "positive per-shard count (pass None to use cfg.num_heads)"
+        )
+    return num_heads
+
+
 def init_kv_cache(
     cfg: LlamaConfig, batch: int, max_len: int, num_heads: int | None = None
 ):
     """``(k, v)`` stacked over layers: ``[L, B, max_len, H, hd]``.
     ``num_heads`` overrides the config for TP decode, where each shard
-    caches only its local ``H/t`` heads."""
+    caches only its local ``H/t`` heads; explicit non-positive
+    overrides raise (:func:`resolve_heads`)."""
     shape = (
-        cfg.n_layers, batch, max_len, num_heads or cfg.num_heads,
+        cfg.n_layers, batch, max_len, resolve_heads(cfg, num_heads),
         cfg.head_dim,
     )
     dtype = jnp.dtype(cfg.dtype)

@@ -40,12 +40,15 @@ COMPILE_REPORT_BASENAME = "compile_report.json"
 # bound strictly above its sync twin's, which needs BOTH twins compiled
 # under every gate (signature pins, graft-lint H008-H010, perfscope);
 # zero1/zero2's overlap twins therefore graduated from on-demand to
-# default.  All fourteen share the tests' lower-once compile cache, so
-# tier-1 pays each compile exactly once.
+# default.  PR 10 adds the two serving programs (serve-decode /
+# serve-prefill: the paged-KV TP inference steps, pinned all-reduce-only
+# like tp but forward-only).  All sixteen share the tests' lower-once
+# compile cache, so tier-1 pays each compile exactly once.
 DEFAULT_STRATEGIES = (
     "dp", "dp-overlap", "zero1", "zero1-overlap", "zero2",
     "zero2-overlap", "zero3", "zero3-prefetch", "zero3-overlap",
     "pipeline", "het_pipeline", "tp", "sp", "ep",
+    "serve-decode", "serve-prefill",
 )
 
 

@@ -28,6 +28,13 @@ import numpy as np
 from ddl25spring_tpu.obs.counters import gpipe_bubble_fraction
 from ddl25spring_tpu.obs.logger import read_jsonl
 
+# the serving artifact a `bench.py --serve` run drops in the obs dir
+# (written by ddl25spring_tpu/serve/driver.py, which imports this name
+# — the obs layer owns its artifact basenames, like FLIGHT_BASENAME /
+# PERF_BASENAME; tools/serve_report.py restates the string to stay
+# stdlib-only)
+SERVE_BASENAME = "serve.json"
+
 
 def _pct(xs: list[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs, dtype=np.float64), q))
@@ -79,10 +86,22 @@ def _phase_summary(steps: list[dict], header: dict) -> dict[str, Any]:
 
 
 def summarize_run(run_dir: str) -> dict[str, Any]:
-    """Summarize one run directory.  Raises FileNotFoundError when there is
-    no ``metrics.jsonl`` (nothing to report on)."""
+    """Summarize one run directory.  Raises FileNotFoundError when there
+    is nothing at all to report on — but a dir holding only serve.json /
+    flight.json (a ``bench.py --serve`` run writes no metrics.jsonl:
+    its per-token records live in serve.json) still summarizes."""
+    from ddl25spring_tpu.obs.recorder import FLIGHT_BASENAME
+
     metrics_path = os.path.join(run_dir, "metrics.jsonl")
-    records = read_jsonl(metrics_path)
+    try:
+        records = read_jsonl(metrics_path)
+    except FileNotFoundError:
+        if not any(
+            os.path.exists(os.path.join(run_dir, f))
+            for f in (SERVE_BASENAME, FLIGHT_BASENAME)
+        ):
+            raise
+        records = []
     # a run may append late header records for facts only known at the
     # end (compiled flops, measured link bandwidth): merge them in order
     header: dict[str, Any] = {}
@@ -246,6 +265,25 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
         except (json.JSONDecodeError, OSError) as e:
             out["perf"] = {"error": f"unreadable {PERF_BASENAME}: {e}"}
 
+    # serving record, when a `bench.py --serve` run dropped one here
+    # (ddl25spring_tpu/serve/driver.py): admission counters, TTFT /
+    # per-token latency percentiles, page-pool occupancy, and the
+    # continuous-vs-static A/B — the Serving section below
+    spath = os.path.join(run_dir, SERVE_BASENAME)
+    if os.path.exists(spath):
+        try:
+            with open(spath) as f:
+                sdoc = json.load(f)
+            out["serve"] = {
+                "key": sdoc.get("key"),
+                "requests": sdoc.get("requests"),
+                "ramp": sdoc.get("ramp"),
+                "ab": sdoc.get("ab"),
+                "git_sha": sdoc.get("git_sha"),
+            }
+        except (json.JSONDecodeError, OSError) as e:
+            out["serve"] = {"error": f"unreadable {SERVE_BASENAME}: {e}"}
+
     # compile-time analytics, when a bench/CLI run dropped its report here
     # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
     # compiled collectives/HBM/MFU-projection below, one run dir
@@ -380,6 +418,61 @@ def format_report(summary: dict[str, Any]) -> str:
                 + f"  (micro comms total {pms('micro_total_s')}"
                 + f" over {n_sites} inventory site(s))"
             )
+
+    sv = summary.get("serve")
+    if sv:
+        lines.append("")
+        lines.append(
+            "serving (serve.json — bench.py --serve; trend/gate with "
+            "tools/serve_report.py):"
+        )
+        if sv.get("error"):
+            lines.append(f"  {sv['error']}")
+        else:
+            ramp = sv.get("ramp") or {}
+            key = sv.get("key") or {}
+            if key:
+                lines.append(
+                    "  " + "  ".join(f"{k}={key[k]}" for k in sorted(key))
+                )
+
+            def sms(v):
+                return f"{v * 1e3:.2f} ms" if isinstance(
+                    v, (int, float)) else "n/a"
+
+            lines.append(
+                f"  requests {sv.get('requests')}  admitted "
+                f"{ramp.get('admitted')}  rejected {ramp.get('rejected')}"
+                f" {ramp.get('rejected_by_reason') or {}}  completed "
+                f"{ramp.get('completed')}"
+            )
+            tps = ramp.get("tokens_per_sec_per_chip")
+            lines.append(
+                "  tokens/sec/chip "
+                + (f"{tps:.2f}" if isinstance(tps, (int, float)) else "n/a")
+                + f"  TTFT p50 {sms(ramp.get('ttft_s_p50'))} p95 "
+                f"{sms(ramp.get('ttft_s_p95'))}"
+                f"  per-token p50 {sms(ramp.get('tok_latency_s_p50'))} "
+                f"p95 {sms(ramp.get('tok_latency_s_p95'))}"
+            )
+            occ = ramp.get("page_pool_peak_occupancy")
+            lines.append(
+                f"  page pool peak {ramp.get('page_pool_peak_pages')}"
+                f"/{ramp.get('page_pool_pages')} pages"
+                + (f" ({occ * 100:.1f}%)" if isinstance(
+                    occ, (int, float)) else "")
+                + f"  queue depth max {ramp.get('queue_depth_max')}"
+                + f"  pool-ok failures {ramp.get('pool_ok_failures')}"
+            )
+            ab = sv.get("ab")
+            if ab:
+                lines.append(
+                    "  A/B continuous "
+                    f"{ab.get('continuous_tokens_at_budget')} vs static "
+                    f"{ab.get('static_tokens_at_budget')} tokens at "
+                    f"budget {ab.get('budget_s')} s  (advantage "
+                    f"{ab.get('advantage_tokens')})"
+                )
 
     c = summary.get("counters", {})
     statics = c.get("static", {})

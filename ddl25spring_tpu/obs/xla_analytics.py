@@ -802,6 +802,21 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "module": "ddl25spring_tpu.parallel.ep",
         "axes": ("expert",), "default_mesh": (4,),
     },
+    # the serving programs (ddl25spring_tpu/serve/engine.py): TP decode
+    # tick and prefill over the paged KV cache — forward-only inference
+    # steps whose pinned signature is "row-parallel all-reduce over the
+    # model axis ONLY" (no permutes/gathers/scatters: serve keeps the
+    # vocab replicated), with HBM budgets like every training strategy
+    "serve-decode": {
+        "module": "ddl25spring_tpu.serve.engine",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "decode"},
+    },
+    "serve-prefill": {
+        "module": "ddl25spring_tpu.serve.engine",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "prefill"},
+    },
 }
 
 

@@ -1,0 +1,360 @@
+"""The ``bench.py --serve`` driver: traffic -> engine -> telemetry.
+
+One call (:func:`run_serve_bench`) produces the whole serving record:
+
+1. **ramp phase** — the seeded open-loop trace (:mod:`.traffic`) drives
+   a continuous-batching engine on the WALL clock: measured TTFT
+   p50/p95, per-token latency, queue depth, admission counters, and
+   page-pool peak occupancy (the ``telemetry.serve`` contract).
+2. **continuous-vs-static A/B** — the SAME trace replayed through two
+   fresh engines on the VIRTUAL clock (every compiled-program call
+   advances ``tick_s``; fully deterministic on any host).  Both run to
+   drain, logging their cumulative token timeline; the fixed budget is
+   the midpoint of the two drain times, and "tokens delivered by the
+   budget" is read off each timeline — one drain run per mode answers
+   every candidate budget, and continuous batching's win (slots refill
+   mid-flight instead of waiting for the batch to drain) is measured on
+   identical work.
+3. **artifacts** — ``serve.json`` in the obs dir (the Serving section
+   of ``tools/obs_report.py``; histograms for ``tools/serve_report.py``)
+   and a ``record: "serve"`` line appended to the perf ledger
+   (``runs/perf_ledger.jsonl``) keyed like perfscope's records (host
+   fingerprint + workload key, git sha as the trend variable) so
+   ``serve_report --check`` gates cross-run regressions.
+
+Engine knobs resolve from ``DDL25_SERVE_*`` env (documented in the
+README's serving section) so CI and operators tune pool geometry and
+admission control without touching code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+# ledger/trend + smoke defaults: the CI smoke must be reproducible, so
+# every knob that shapes the workload lands in the record's key
+SMOKE_TRAFFIC = {"duration_s": 2.0, "rate_rps": 6.0, "profile": "ramp",
+                 "seed": 0}
+
+
+def engine_knobs(smoke: bool = False) -> dict[str, Any]:
+    """Pool geometry + admission-control knobs: ``DDL25_SERVE_*`` env
+    over (smoke-sized or serving-sized) defaults."""
+    from ddl25spring_tpu.utils.config import env_int
+
+    d = (
+        dict(page_len=4, n_pages=16, max_slots=2, prefill_batch=2,
+             max_prompt_len=8, max_queue=32, token_budget=0)
+        if smoke else
+        dict(page_len=16, n_pages=64, max_slots=4, prefill_batch=2,
+             max_prompt_len=32, max_queue=64, token_budget=0)
+    )
+    eos = env_int("DDL25_SERVE_EOS", -1)
+    return {
+        "page_len": env_int("DDL25_SERVE_PAGE_LEN", d["page_len"]),
+        "n_pages": env_int("DDL25_SERVE_N_PAGES", d["n_pages"]),
+        "max_slots": env_int("DDL25_SERVE_SLOTS", d["max_slots"]),
+        "prefill_batch": env_int(
+            "DDL25_SERVE_PREFILL_BATCH", d["prefill_batch"]
+        ),
+        "max_prompt_len": env_int(
+            "DDL25_SERVE_MAX_PROMPT", d["max_prompt_len"]
+        ),
+        "max_queue": env_int("DDL25_SERVE_MAX_QUEUE", d["max_queue"]),
+        # 0 = unlimited (the knob is backpressure, not a requirement)
+        "token_budget": (
+            env_int("DDL25_SERVE_TOKEN_BUDGET", d["token_budget"]) or None
+        ),
+        "eos_id": None if eos < 0 else eos,
+    }
+
+
+def serve_model(model: str):
+    """The model the bench serves: ``tiny`` (the CI smoke / test config
+    — fp32 so the paged-vs-dense pin is bitwise) or ``ref`` (the
+    reference LLaMA workload constants, bf16)."""
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    if model == "tiny":
+        return LlamaConfig(
+            vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
+            ctx_size=32, dtype="float32",
+        )
+    if model == "ref":
+        return LlamaConfig()
+    raise ValueError(f"model={model!r} is not 'tiny' or 'ref'")
+
+
+def _build_engine(params, cfg, knobs: dict[str, Any], **over):
+    from ddl25spring_tpu.serve.engine import ServeEngine
+
+    kw = dict(knobs)
+    kw.update(over)
+    return ServeEngine(params, cfg, **kw)
+
+
+def ab_tick_s(trace, max_slots: int) -> float:
+    """The A/B's virtual tick length, sized so decode capacity
+    (``max_slots / tick_s`` tokens/s) sits at ~75% of the trace's mean
+    token demand: the engine saturates, a queue forms, and the two
+    admission policies differ where continuous batching exists to
+    differ — slots refilling mid-flight under backlog.  An unloaded
+    engine serves both policies identically and the A/B would tie."""
+    if not trace:
+        return 5e-3
+    duration = max(r["t"] for r in trace) or 1.0
+    demand = sum(r["max_new"] for r in trace) / duration  # tokens/s
+    if demand <= 0:
+        return 5e-3
+    return min(max(max_slots / (0.75 * demand), 1e-4), 1.0)
+
+
+def ab_compare(
+    params, cfg, trace, knobs: dict[str, Any], *,
+    tick_s: float | None = None, max_steps: int = 20_000,
+    temperature: float = 0.0, sentinel: bool | None = None,
+) -> dict[str, Any]:
+    """Continuous vs static admission on the identical trace, virtual
+    clock: run both to drain, fix the budget at the midpoint of the two
+    drain walls, read tokens-delivered-by-budget off each timeline.
+    ``temperature``/``sentinel`` must match the ramp engine's — the A/B
+    cell lands in a ledger row keyed by the ramp's configuration.
+
+    Both engines get ``prefill_batch=max_slots``: the static arm only
+    admits into an all-idle batch, so a narrower prefill width would
+    permanently cap it below ``max_slots`` concurrent sequences and the
+    advantage would conflate admission policy with batch width.  Equal
+    width makes the delta count exactly the ticks static admission left
+    freed slots idle."""
+    if tick_s is None:
+        tick_s = ab_tick_s(trace, knobs["max_slots"])
+    out: dict[str, Any] = {}
+    engines = {}
+    for adm in ("continuous", "static"):
+        e = _build_engine(
+            params, cfg, knobs, admission=adm, clock="virtual",
+            tick_s=tick_s, temperature=temperature, sentinel=sentinel,
+            prefill_batch=knobs["max_slots"],
+        )
+        m = e.run(trace, max_steps=max_steps)
+        engines[adm] = e
+        out[adm] = {
+            "drain_wall_s": m["wall_s"],
+            "ticks": m["ticks"],
+            "prefills": m["prefills"],
+            "generated_tokens": m["generated_tokens"],
+            "completed": m["completed"],
+            "rejected": m["rejected"],
+        }
+    budget = round(
+        (out["continuous"]["drain_wall_s"] + out["static"]["drain_wall_s"])
+        / 2, 6,
+    )
+    cont = engines["continuous"].tokens_at(budget)
+    stat = engines["static"].tokens_at(budget)
+    out.update(
+        budget_s=budget,
+        tick_s=tick_s,
+        continuous_tokens_at_budget=cont,
+        static_tokens_at_budget=stat,
+        advantage_tokens=cont - stat,
+        advantage_frac=round((cont - stat) / stat, 4) if stat else None,
+    )
+    return out
+
+
+def run_serve_bench(
+    *,
+    smoke: bool = False,
+    model: str | None = None,
+    obs_dir: str | None = None,
+    duration_s: float | None = None,
+    rate_rps: float | None = None,
+    profile: str | None = None,
+    seed: int | None = None,
+    budget_s: float | None = None,
+    ledger_path: str | None = None,
+    temperature: float = 0.0,
+    sentinel: bool | None = None,
+    skip_ab: bool = False,
+) -> dict[str, Any]:
+    """The whole serving bench; returns the BENCH record (one JSON line
+    with ``telemetry.serve``).  ``budget_s`` bounds the wall-clock ramp
+    phase (None = run to drain)."""
+    import jax
+
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.obs import flight, sentinels
+    from ddl25spring_tpu.obs.logger import git_sha
+    from ddl25spring_tpu.obs.perfscope import host_fingerprint
+    from ddl25spring_tpu.obs.report import SERVE_BASENAME
+    from ddl25spring_tpu.serve.traffic import TrafficSpec, synth_trace
+
+    t_start = time.perf_counter()
+    model = model or ("tiny" if smoke else "ref")
+    cfg = serve_model(model)
+    knobs = engine_knobs(smoke=smoke)
+    traffic_defaults = SMOKE_TRAFFIC if smoke else {
+        "duration_s": 30.0, "rate_rps": 8.0, "profile": "ramp", "seed": 0,
+    }
+    spec = TrafficSpec(
+        seed=traffic_defaults["seed"] if seed is None else seed,
+        duration_s=(
+            traffic_defaults["duration_s"] if duration_s is None
+            else duration_s
+        ),
+        rate_rps=(
+            traffic_defaults["rate_rps"] if rate_rps is None else rate_rps
+        ),
+        profile=profile or traffic_defaults["profile"],
+        vocab_size=cfg.vocab_size,
+    )
+    trace = synth_trace(spec)
+    flight.annotate(
+        serve_model=model, serve_profile=spec.profile,
+        serve_seed=spec.seed, serve_requests=len(trace),
+    )
+
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+
+    # --- ramp phase: wall clock, the measured serving numbers ----------
+    eng = _build_engine(
+        params, cfg, knobs, clock="wall", temperature=temperature,
+        sentinel=sentinel,
+    )
+    eng.warmup()  # compile OFF the clock: TTFT measures serving, not XLA
+    ramp = eng.run(trace, budget_s=budget_s, max_steps=50_000)
+
+    # --- continuous-vs-static A/B: virtual clock, deterministic -------
+    ab = None
+    if not skip_ab:
+        ab = ab_compare(
+            params, cfg, trace, knobs,
+            temperature=temperature, sentinel=sentinel,
+        )
+
+    record: dict[str, Any] = {
+        "record": "serve",
+        "ts": time.time(),
+        "git_sha": git_sha(),
+        "host": host_fingerprint(),
+        "key": {
+            "model": model,
+            "profile": spec.profile,
+            "seed": spec.seed,
+            "rate_rps": spec.rate_rps,
+            "duration_s": spec.duration_s,
+            "page_len": knobs["page_len"],
+            "n_pages": knobs["n_pages"],
+            "max_slots": knobs["max_slots"],
+            # sentinel guards price into every compiled call (host
+            # callback per tick), so on/off rows are different
+            # measurements — keyed apart, they never gate each other
+            "sentinels": bool(sentinels.resolve(sentinel)[0]),
+        },
+        "requests": len(trace),
+        "ramp": ramp,
+        **({"ab": ab} if ab is not None else {}),
+        # bounded raw samples for serve_report's histogram (the summary
+        # percentiles above are what the gates read)
+        "ttft_s": [round(x, 6) for x in eng.ttft_s[:512]],
+        "tick_wall_s": [round(x, 6) for x in eng.tick_wall_s[:512]],
+        "bench_wall_s": round(time.perf_counter() - t_start, 3),
+    }
+    if obs_dir:
+        os.makedirs(obs_dir, exist_ok=True)
+        path = os.path.join(obs_dir, SERVE_BASENAME)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, default=str)
+        os.replace(tmp, path)
+        record["serve_json"] = path
+    if ledger_path is not None:
+        from ddl25spring_tpu.obs.perfscope import append_ledger
+
+        try:
+            record["ledger"] = append_ledger(
+                ledger_record(record), ledger_path
+            )
+        except OSError as e:  # a read-only FS must not kill the line
+            record["ledger_error"] = str(e)
+    return record
+
+
+def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
+    """The trend row ``serve_report --check`` gates: the summary
+    numbers only (never the raw sample lists — the ledger is read by a
+    stdlib tool and grows one line per run)."""
+    ramp = record["ramp"]
+    out = {
+        "record": "serve",
+        "ts": record["ts"],
+        "git_sha": record["git_sha"],
+        "host": record["host"],
+        "key": record["key"],
+        "tokens_per_sec": ramp.get("tokens_per_sec"),
+        "tokens_per_sec_per_chip": ramp.get("tokens_per_sec_per_chip"),
+        "ttft_s_p50": ramp.get("ttft_s_p50"),
+        "ttft_s_p95": ramp.get("ttft_s_p95"),
+        "tok_latency_s_p50": ramp.get("tok_latency_s_p50"),
+        "tok_latency_s_p95": ramp.get("tok_latency_s_p95"),
+        "admitted": ramp.get("admitted"),
+        "rejected": ramp.get("rejected"),
+        "completed": ramp.get("completed"),
+        "page_pool_peak_occupancy": ramp.get("page_pool_peak_occupancy"),
+    }
+    ab = record.get("ab")
+    if ab:
+        out["ab"] = {
+            k: ab.get(k)
+            for k in (
+                "budget_s", "continuous_tokens_at_budget",
+                "static_tokens_at_budget", "advantage_tokens",
+                "advantage_frac",
+            )
+        }
+    return out
+
+
+def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
+    """The ``telemetry.serve`` BENCH cell — every contract key the CI
+    smoke asserts (tokens/sec/chip, TTFT + per-token p50/p95, admission
+    counters, pool occupancy) plus the A/B verdict."""
+    ramp = record["ramp"]
+    cell = {
+        "tokens_per_sec_per_chip": ramp.get("tokens_per_sec_per_chip"),
+        "ttft_s_p50": ramp.get("ttft_s_p50"),
+        "ttft_s_p95": ramp.get("ttft_s_p95"),
+        "tok_latency_s_p50": ramp.get("tok_latency_s_p50"),
+        "tok_latency_s_p95": ramp.get("tok_latency_s_p95"),
+        "admitted": ramp.get("admitted"),
+        "rejected": ramp.get("rejected"),
+        "rejected_by_reason": ramp.get("rejected_by_reason"),
+        "completed": ramp.get("completed"),
+        "generated_tokens": ramp.get("generated_tokens"),
+        "queue_depth_max": ramp.get("queue_depth_max"),
+        "page_pool_peak_pages": ramp.get("page_pool_peak_pages"),
+        "page_pool_peak_occupancy": ramp.get("page_pool_peak_occupancy"),
+        "pool_ok_failures": ramp.get("pool_ok_failures"),
+        "n_chips": ramp.get("n_chips"),
+        "requests": record.get("requests"),
+        "key": record.get("key"),
+    }
+    ab = record.get("ab")
+    if ab:
+        cell["ab"] = {
+            "budget_s": ab.get("budget_s"),
+            "continuous_tokens_at_budget": ab.get(
+                "continuous_tokens_at_budget"
+            ),
+            "static_tokens_at_budget": ab.get("static_tokens_at_budget"),
+            "advantage_tokens": ab.get("advantage_tokens"),
+            "advantage_frac": ab.get("advantage_frac"),
+        }
+    for k in ("ledger", "ledger_error", "serve_json"):
+        if record.get(k):
+            cell[k] = record[k]
+    return cell

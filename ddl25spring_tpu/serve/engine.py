@@ -1,0 +1,1080 @@
+"""Continuous-batching LLaMA decode engine over the paged KV cache.
+
+ROADMAP item 3's serving path: ``models/decode.py`` gives the framework
+a *correct* cached decode loop, this module makes it *serve* —
+
+- **prefill/decode disaggregation**: two separately compiled
+  static-shape programs.  ``prefill`` scans a padded prompt batch
+  through the cached step, writing KV pages and emitting each request's
+  first sampled token; ``decode`` packs every active slot into ONE
+  ``[max_slots]`` tick, each tick appending one token per live sequence
+  (inactive slots ride along masked — the static-shape tax).
+- **continuous batching**: a sequence that hits EOS / its length stop
+  mid-flight releases its slot AND its pages; the very next scheduler
+  iteration admits queued requests into the freed capacity (the dense
+  ``[B, max_len]`` slab can't do this — capacity only returned when the
+  whole batch drained).  ``admission="static"`` disables exactly that
+  (a new batch forms only when ALL slots are idle) — the A/B
+  ``bench.py --serve`` prices into the perf ledger.
+- **admission control**: a bounded queue, a queued-token budget
+  (backpressure under ramp overload), and reject-with-reason — every
+  rejection is counted by cause (``queue_full`` / ``token_budget`` /
+  ``too_long`` / ``pool_exhausted``), the serving telemetry's contract.
+
+The PR-1..9 stacks carry over rather than being re-invented: decode
+sentinels guard the logits numerics inside the compiled tick
+(:mod:`ddl25spring_tpu.obs.sentinels`, same DDL25_SENTINELS gate and
+policies as every train step), each scheduler iteration lands in the
+flight-recorder ring so a dead server is post-mortemable, and the
+``describe()`` hooks at the bottom register ``serve-decode`` /
+``serve-prefill`` with the compile-analytics/graft-lint registry — the
+TP decode signature (row-parallel all-reduces ONLY, everything else
+forbidden) and HBM budgets pin in CI like every training strategy.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ddl25spring_tpu.models import decode as decode_mod, llama
+from ddl25spring_tpu.obs import sentinels
+from ddl25spring_tpu.serve import kv_pages
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Params = dict[str, Any]
+
+# submit()-time rejection reasons — the admission-control contract the
+# serving telemetry counts by cause
+REJECT_QUEUE_FULL = "queue_full"
+REJECT_TOKEN_BUDGET = "token_budget"
+REJECT_TOO_LONG = "too_long"
+REJECT_POOL_EXHAUSTED = "pool_exhausted"
+REJECT_BAD_REQUEST = "bad_request"  # empty prompt / non-positive max_new
+
+
+# ------------------------------------------------------ compiled programs
+
+
+def _rope_rows(x, cos, sin):
+    """RoPE for a single-token batch whose POSITION varies per row:
+    ``x [B, 1, H, hd]``, ``cos/sin [B, hd/2]``.  Same arithmetic as
+    :func:`~ddl25spring_tpu.models.llama.apply_rope` (which aligns cos
+    with the sequence axis — here the position lives on the batch axis
+    instead), so fp32 values match the dense decode bitwise."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[:, None, None, :]
+    s = sin[:, None, None, :]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _paged_block(p, x, kp, vp, layer, rows, pages, offs, pos, cos, sin,
+                 cfg: LlamaConfig, tp_axis: str | None):
+    """One transformer block on a single-token slice ``x [B, 1, D]``
+    against the PAGE POOL — the paged twin of
+    :func:`ddl25spring_tpu.models.decode._block_decode`, op for op
+    (same einsums, same fp32 softmax, same ``-1e30`` mask fill), so the
+    fp32 equivalence pin holds bitwise.  ``rows`` is the clamped page
+    table ``[B, P]`` of the sequences in this batch; ``pages``/``offs``
+    the write coordinates of position ``pos`` (trash-routed where
+    masked)."""
+    dtype = jnp.dtype(cfg.dtype)
+    B = x.shape[0]
+    hd = cfg.head_dim
+
+    h = llama.rms_norm(x, p["ln1"])
+    q = (h @ p["wq"].astype(dtype)).reshape(B, 1, -1, hd)
+    k = (h @ p["wk"].astype(dtype)).reshape(B, 1, -1, hd)
+    v = (h @ p["wv"].astype(dtype)).reshape(B, 1, -1, hd)
+    q = _rope_rows(q, cos, sin)
+    k = _rope_rows(k, cos, sin)
+
+    kp, vp = kv_pages.append_layer_kv(
+        kp, vp, layer, pages, offs, k[:, 0], v[:, 0]
+    )
+    ks = kp[rows, layer]  # [B, P, page_len, H, hd]
+    vs = vp[rows, layer]
+    P, page_len = ks.shape[1], ks.shape[2]
+    ks = ks.reshape(B, P * page_len, -1, hd)
+    vs = vs.reshape(B, P * page_len, -1, hd)
+
+    s = jnp.einsum("bqhd,bmhd->bhqm", q, ks).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(hd))
+    live = jnp.arange(P * page_len)[None, :] <= pos[:, None]
+    s = jnp.where(live[:, None, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(dtype)
+    attn = jnp.einsum("bhqm,bmhd->bqhd", probs, vs)
+    attn_out = attn.reshape(B, 1, -1) @ p["wo"].astype(dtype)
+    if tp_axis is not None:
+        attn_out = lax.psum(attn_out, tp_axis)
+    x = x + attn_out
+
+    h = llama.rms_norm(x, p["ln2"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dtype))
+    up = h @ p["w_up"].astype(dtype)
+    ffn_out = (gate * up) @ p["w_down"].astype(dtype)
+    if tp_axis is not None:
+        ffn_out = lax.psum(ffn_out, tp_axis)
+    return x + ffn_out, kp, vp
+
+
+def make_decode_tick(
+    cfg: LlamaConfig,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    tp_axis: str | None = None,
+    sentinel: bool | None = None,
+    strategy: str = "serve-decode",
+):
+    """Build the decode program body: one token for EVERY active slot.
+
+    ``tick(params, pool, tokens, key) -> (pool, new_tokens, ok)`` —
+    ``tokens [max_slots]`` are the tokens to append at each slot's
+    current position (the previous tick's samples), ``new_tokens`` the
+    next ones, ``ok`` the pool-exhaustion backstop flag.  Static shapes
+    throughout: one compile serves the engine's whole lifetime.  The
+    gate+policy of the logits sentinel resolve at BUILD time
+    (:func:`ddl25spring_tpu.obs.sentinels.resolve`)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "serve/ decodes dense-FFN configs only (MoE decode exists in "
+            "models/decode.py; paging it is future work)"
+        )
+    s_on, s_policy = sentinels.resolve(sentinel)
+
+    def tick(params, pool, tokens, key):
+        active = pool["active"]
+        pos = pool["seq_len"]  # [S] — position this tick writes
+        page_len = pool["k"].shape[2]
+        n_pages = pool["free"].shape[0]
+        S = tokens.shape[0]
+        slots = jnp.arange(S, dtype=jnp.int32)
+
+        need = active & (pos % page_len == 0)
+        pool, ok = kv_pages.reserve_pages(pool, slots, pos, need)
+        pages, offs = kv_pages.write_page_ids(pool, slots, pos, active)
+        rows = jnp.clip(pool["page_table"], 0, n_pages - 1)  # [S, P]
+
+        x = llama.embed(params, tokens[:, None], cfg)
+        cos, sin = llama.rope_angles(
+            1, cfg.head_dim, pos=pos.astype(jnp.float32)
+        )
+
+        def layer(carry, inp):
+            x, kp, vp = carry
+            bp, li = inp
+            x, kp, vp = _paged_block(
+                bp, x, kp, vp, li, rows, pages, offs, pos, cos, sin,
+                cfg, tp_axis,
+            )
+            return (x, kp, vp), None
+
+        (x, kp, vp), _ = lax.scan(
+            layer, (x, pool["k"], pool["v"]),
+            (params["blocks"], jnp.arange(cfg.n_layers)),
+        )
+        logits = llama.unembed(params, x, cfg)[:, 0]  # [S, V] fp32
+        if temperature == 0.0:
+            new_tok = logits.argmax(-1).astype(jnp.int32)
+        else:
+            new_tok = decode_mod.sample_logits(
+                logits, key, temperature, top_k, top_p
+            )
+        pool = {
+            **pool, "k": kp, "v": vp,
+            "seq_len": jnp.where(active, pos + 1, pos),
+        }
+        # decode-step sentinel: a non-finite logit on any ACTIVE slot is
+        # the serving analogue of a NaN loss (inactive slots carry
+        # garbage by construction — masked out of the check)
+        new_tok, pool = sentinels.guard(
+            strategy, (new_tok, pool),
+            loss=jnp.max(jnp.where(active, jnp.max(
+                jnp.abs(logits), axis=-1), 0.0)),
+            updates={"logits": jnp.where(active[:, None], logits, 0.0)},
+            fallback=(new_tok, pool),
+            axis=tp_axis, enabled=s_on, policy=s_policy,
+        )
+        return pool, new_tok, ok
+
+    return tick
+
+
+def make_prefill(
+    cfg: LlamaConfig,
+    *,
+    max_prompt_len: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    tp_axis: str | None = None,
+    sentinel: bool | None = None,
+    strategy: str = "serve-prefill",
+):
+    """Build the prefill program body: write a padded prompt batch into
+    the pool and sample each request's FIRST generated token.
+
+    ``prefill(params, pool, prompts, lens, slot_ids, key) ->
+    (pool, first_tokens, ok)`` — ``prompts [B, max_prompt_len]`` int32
+    (pad beyond ``lens``), ``slot_ids [B]`` the target slots (``-1`` =
+    padding row, which writes only to the trash page).  The prompt
+    positions run through the SAME cached single-token step as decode,
+    scanned over ``max_prompt_len`` (weights are the bandwidth bound at
+    these shapes; a fused wide-prompt pass is a future optimization the
+    compile-signature pin would catch drifting).  On exit the target
+    slots are active with ``seq_len = lens`` — exactly the state the
+    next decode tick expects."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("serve/ decodes dense-FFN configs only")
+    s_on, s_policy = sentinels.resolve(sentinel)
+
+    def prefill(params, pool, prompts, lens, slot_ids, key):
+        B = prompts.shape[0]
+        n_pages = pool["free"].shape[0]
+        page_len = pool["k"].shape[2]
+        valid_row = slot_ids >= 0
+        pool = kv_pages.activate_slots(pool, slot_ids, valid_row)
+
+        def body(carry, i):
+            pool, last_logits, ok_all = carry
+            tok = prompts[:, i]
+            pos = jnp.full((B,), i, jnp.int32)
+            writing = valid_row & (i < lens)
+            need = writing & (i % page_len == 0)
+            pool, ok = kv_pages.reserve_pages(pool, slot_ids, pos, need)
+            pages, offs = kv_pages.write_page_ids(
+                pool, slot_ids, pos, writing
+            )
+            rows = jnp.clip(
+                pool["page_table"][
+                    jnp.clip(slot_ids, 0, pool["page_table"].shape[0] - 1)
+                ],
+                0, n_pages - 1,
+            )  # [B, P]
+
+            x = llama.embed(params, tok[:, None], cfg)
+            cos, sin = llama.rope_angles(
+                1, cfg.head_dim, pos=pos.astype(jnp.float32)
+            )
+
+            def layer(carry, inp):
+                x, kp, vp = carry
+                bp, li = inp
+                x, kp, vp = _paged_block(
+                    bp, x, kp, vp, li, rows, pages, offs, pos, cos, sin,
+                    cfg, tp_axis,
+                )
+                return (x, kp, vp), None
+
+            (x, kp, vp), _ = lax.scan(
+                layer, (x, pool["k"], pool["v"]),
+                (params["blocks"], jnp.arange(cfg.n_layers)),
+            )
+            logits = llama.unembed(params, x, cfg)[:, 0]
+            last_logits = jnp.where(
+                (i == lens - 1)[:, None], logits, last_logits
+            )
+            pool = {**pool, "k": kp, "v": vp}
+            return (pool, last_logits, ok_all & ok), None
+
+        (pool, last_logits, ok), _ = lax.scan(
+            body,
+            (pool, jnp.zeros((B, cfg.vocab_size), jnp.float32),
+             jnp.bool_(True)),
+            jnp.arange(max_prompt_len),
+        )
+        if temperature == 0.0:
+            first = last_logits.argmax(-1).astype(jnp.int32)
+        else:
+            first = decode_mod.sample_logits(
+                last_logits, key, temperature, top_k, top_p
+            )
+        sent = jnp.where(
+            valid_row, slot_ids, pool["seq_len"].shape[0]
+        )
+        pool = {
+            **pool,
+            "seq_len": pool["seq_len"].at[sent].set(lens, mode="drop"),
+        }
+        first, pool = sentinels.guard(
+            strategy, (first, pool),
+            loss=jnp.max(jnp.where(valid_row, jnp.max(
+                jnp.abs(last_logits), axis=-1), 0.0)),
+            updates={"logits": jnp.where(
+                valid_row[:, None], last_logits, 0.0)},
+            fallback=(first, pool),
+            axis=tp_axis, enabled=s_on, policy=s_policy,
+        )
+        return pool, first, ok
+
+    return prefill
+
+
+def _release(pool, mask):
+    return kv_pages.release_slots(pool, mask)
+
+
+# One compiled (tick, prefill, release) triple per build key: the ramp
+# engine and both A/B engines of a `bench.py --serve` run (and every
+# same-config test engine) reuse XLA programs instead of paying the
+# compile bill per ServeEngine.  Keyed on everything that shapes the
+# BUILT program — cfg (frozen dataclass), prompt width, sampling, the
+# RESOLVED sentinel gate+policy (env is read at build time, so an env
+# flip lands in the key), and donation.
+_PROGRAM_CACHE: dict[tuple, tuple] = {}
+
+
+def _compiled_programs(
+    cfg: LlamaConfig, *, max_prompt_len: int, temperature: float,
+    sentinel: bool | None, donate: bool,
+):
+    key = (
+        cfg, max_prompt_len, temperature, sentinels.resolve(sentinel),
+        donate,
+    )
+    if key not in _PROGRAM_CACHE:
+        tick = make_decode_tick(
+            cfg, temperature=temperature, sentinel=sentinel
+        )
+        pre = make_prefill(
+            cfg, max_prompt_len=max_prompt_len, temperature=temperature,
+            sentinel=sentinel,
+        )
+        # tick/prefill donate their POOL argument (position 1).  release
+        # deliberately does NOT donate: aliasing the pool through the
+        # release program was measured to slow every SUBSEQUENT
+        # tick/prefill call ~5x on the CPU backend (ramp TTFT p50
+        # 3.4 ms -> 10-26 ms), while the un-donated release copy runs
+        # once per completion burst — the cheap side of that trade.
+        # Revisit on a real-HBM pool if the transient 2x release-time
+        # footprint ever bites before the per-call tax does.
+        pool_kw = {"donate_argnums": (1,)} if donate else {}
+        _PROGRAM_CACHE[key] = (
+            jax.jit(tick, **pool_kw),
+            jax.jit(pre, **pool_kw),
+            jax.jit(_release),
+        )
+    return _PROGRAM_CACHE[key]
+
+
+# ----------------------------------------------------------- host engine
+
+
+@dataclass
+class Request:
+    """One inference request (host side)."""
+
+    rid: int
+    prompt: Any  # 1-D int array/list of token ids
+    max_new_tokens: int
+    arrival_t: float = 0.0
+    # filled by the engine
+    admitted_t: float | None = None
+    first_token_t: float | None = None
+    done_t: float | None = None
+    tokens: list = field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+
+class ServeEngine:
+    """The scheduler loop: admission -> prefill -> packed decode ticks.
+
+    Host-side state (queue, per-slot request records, page accounting)
+    stays in Python; everything per-token runs in the two compiled
+    programs.  The page accounting is mirrored on the host — admission
+    reserves each request's WORST-CASE page need
+    (``ceil((prompt + max_new) / page_len)``), so a request admitted is
+    a request that can always finish; the device-side ``ok`` flag is
+    the backstop that this invariant held.
+
+    ``clock="wall"`` uses real time (the bench path);
+    ``clock="virtual"`` advances ``tick_s`` per program call — fully
+    deterministic, which is what the continuous-vs-static equivalence
+    and admission tests pin.
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        *,
+        page_len: int = 16,
+        n_pages: int = 64,
+        max_slots: int = 4,
+        pages_per_seq: int | None = None,
+        prefill_batch: int = 2,
+        max_prompt_len: int = 32,
+        max_queue: int = 64,
+        token_budget: int | None = None,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        admission: str = "continuous",
+        sentinel: bool | None = None,
+        donate: bool = True,
+        clock: str = "wall",
+        tick_s: float = 1e-3,
+        seed: int = 0,
+    ):
+        if admission not in ("continuous", "static"):
+            raise ValueError(
+                f"admission={admission!r} is not 'continuous' or 'static'"
+            )
+        if clock not in ("wall", "virtual"):
+            raise ValueError(f"clock={clock!r} is not 'wall' or 'virtual'")
+        if prefill_batch < 1:
+            # a 0-width prefill admits nothing and the virtual clock
+            # never advances — the run() loop would spin to max_steps
+            raise ValueError(
+                f"prefill_batch={prefill_batch} must be >= 1"
+            )
+        self.cfg = cfg
+        self.params = params
+        self.page_len = page_len
+        self.n_pages = n_pages
+        self.max_slots = max_slots
+        if pages_per_seq is None:  # explicit 0 must FAIL in the pool
+            pages_per_seq = max(1, -(-cfg.ctx_size // page_len))
+        self.pages_per_seq = pages_per_seq
+        self.max_seq_len = self.pages_per_seq * page_len
+        self.prefill_batch = prefill_batch
+        self.max_prompt_len = max_prompt_len
+        self.max_queue = max_queue
+        self.token_budget = token_budget
+        self.eos_id = eos_id
+        self.admission = admission
+        self.clock = clock
+        self.tick_s = tick_s
+        self._key = jax.random.PRNGKey(seed)
+
+        self.pool = kv_pages.init_page_pool(
+            cfg, n_pages=n_pages, page_len=page_len, max_slots=max_slots,
+            pages_per_seq=self.pages_per_seq,
+        )
+        self._tick, self._prefill, self._release = _compiled_programs(
+            cfg, max_prompt_len=max_prompt_len, temperature=temperature,
+            sentinel=sentinel, donate=donate,
+        )
+
+        # host state
+        self.queue: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * max_slots
+        self._slot_last_tok: list[int] = [0] * max_slots
+        self._reserved: list[int] = [0] * max_slots  # pages per slot
+        self._release_mask: list[bool] = [False] * max_slots
+        # pages a completed slot still holds on device until the next
+        # release flush — part of the exact free-mask mirror
+        self._pending_pages: list[int] = [0] * max_slots
+        self._t0 = time.perf_counter()
+        self._vtime = 0.0
+        self._ticks = 0
+        self._prefills = 0
+        self._next_rid = 0
+        # telemetry
+        self.admitted = 0
+        self.completed = 0
+        self.rejected: dict[str, int] = {}
+        self.generated_tokens = 0
+        self.pool_ok_failures = 0
+        self.peak_pages = 0
+        self.queue_depths: list[int] = []
+        self.ttft_s: list[float] = []
+        self.tick_wall_s: list[float] = []
+        self.done: list[Request] = []
+        # cumulative generated-token timeline [(t, tokens)], one point
+        # per scheduler iteration — lets the continuous-vs-static A/B
+        # evaluate "tokens delivered by time B" for ANY budget B from a
+        # single drain run instead of re-running per candidate budget
+        self.token_log: list[tuple[float, int]] = []
+
+    # ---- time ----------------------------------------------------------
+
+    def now(self) -> float:
+        if self.clock == "virtual":
+            return self._vtime
+        return time.perf_counter() - self._t0
+
+    def warmup(self) -> None:
+        """Compile all three programs (prefill, decode tick, release)
+        before the clock starts, then reset every piece of host state
+        and telemetry: a serving bench must not bill XLA compile time
+        as the first requests' TTFT.  The jitted wrappers persist, so
+        the warmed compiles are reused; the pool is rebuilt fresh.
+
+        Admission knobs and EOS are suspended for the probe request:
+        an ``eos_id`` that matches the probe's greedy sample (or a tiny
+        ``token_budget``) would otherwise end the warmup before the
+        decode tick ever compiled, silently putting XLA back on the
+        first real request's TTFT clock."""
+        saved_eos, saved_budget = self.eos_id, self.token_budget
+        self.eos_id, self.token_budget = None, None
+        try:
+            req = self.make_request([1], 2)  # 2nd token needs a decode tick
+            if self.submit(req) is not None:
+                import warnings
+
+                warnings.warn(
+                    "serve warmup probe rejected "
+                    f"({list(self.rejected)}); the first real request "
+                    "will pay XLA compile time",
+                    stacklevel=2,
+                )
+            for _ in range(8):
+                if not self.step():
+                    break
+        finally:
+            self.eos_id, self.token_budget = saved_eos, saved_budget
+        self.pool = kv_pages.init_page_pool(
+            self.cfg, n_pages=self.n_pages, page_len=self.page_len,
+            max_slots=self.max_slots, pages_per_seq=self.pages_per_seq,
+        )
+        self.queue.clear()
+        self.slots = [None] * self.max_slots
+        self._slot_last_tok = [0] * self.max_slots
+        self._reserved = [0] * self.max_slots
+        self._release_mask = [False] * self.max_slots
+        self._pending_pages = [0] * self.max_slots
+        self._vtime = 0.0
+        self._ticks = self._prefills = 0
+        self.admitted = self.completed = self.generated_tokens = 0
+        self.rejected = {}
+        self.pool_ok_failures = 0
+        self.peak_pages = 0
+        self.queue_depths, self.ttft_s, self.tick_wall_s = [], [], []
+        self.done, self.token_log = [], []
+        self._t0 = time.perf_counter()
+
+    def _advance(self, dt: float) -> None:
+        if self.clock == "virtual":
+            self._vtime += dt
+
+    # ---- admission -----------------------------------------------------
+
+    def _pages_needed(self, req: Request) -> int:
+        return -(-(req.prompt_len + req.max_new_tokens) // self.page_len)
+
+    def _reserved_total(self) -> int:
+        return sum(self._reserved)
+
+    def make_request(self, prompt, max_new_tokens: int,
+                     arrival_t: float | None = None) -> Request:
+        rid = self._next_rid
+        self._next_rid += 1
+        return Request(
+            rid=rid, prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            arrival_t=self.now() if arrival_t is None else arrival_t,
+        )
+
+    def submit(self, req: Request) -> str | None:
+        """Admission control at the door.  Returns None on acceptance
+        (queued), else the rejection reason (also counted)."""
+        reason = None
+        total = req.prompt_len + req.max_new_tokens
+        if req.prompt_len < 1 or req.max_new_tokens < 1:
+            # an empty prompt would decode from the zero-initialized
+            # logits buffer (a token the model never produced); reject
+            # at the door rather than serve garbage
+            reason = REJECT_BAD_REQUEST
+        elif (req.prompt_len > self.max_prompt_len
+                or total > self.max_seq_len):
+            reason = REJECT_TOO_LONG
+        elif self._pages_needed(req) > self.n_pages:
+            reason = REJECT_POOL_EXHAUSTED
+        elif len(self.queue) >= self.max_queue:
+            reason = REJECT_QUEUE_FULL
+        elif self.token_budget is not None and (
+            sum(r.prompt_len + r.max_new_tokens for r in self.queue)
+            + total > self.token_budget
+        ):
+            reason = REJECT_TOKEN_BUDGET
+        if reason is not None:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+            return reason
+        self.queue.append(req)
+        return None
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def _admittable(self) -> list[tuple[int, Request]]:
+        """(slot, request) pairs the scheduler can admit right now:
+        bounded by free slots, the prefill batch width, and the pool's
+        unreserved pages (worst-case accounting)."""
+        if self.admission == "static" and any(
+            r is not None for r in self.slots
+        ):
+            return []  # static batching: wait for the batch to drain
+        free = self._free_slots()
+        budget = self.n_pages - self._reserved_total()
+        out: list[tuple[int, Request]] = []
+        while (self.queue and free
+               and len(out) < self.prefill_batch):
+            need = self._pages_needed(self.queue[0])
+            if need > budget:
+                break  # head-of-line blocks until pages free (backpressure)
+            req = self.queue.popleft()
+            slot = free.pop(0)
+            budget -= need
+            out.append((slot, req))
+        return out
+
+    # ---- the scheduler iteration --------------------------------------
+
+    def _split_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _run_prefill(self, batch: list[tuple[int, Request]]) -> None:
+        from ddl25spring_tpu.obs import flight
+
+        B = self.prefill_batch
+        prompts = np.zeros((B, self.max_prompt_len), np.int32)
+        lens = np.zeros((B,), np.int32)
+        slot_ids = np.full((B,), -1, np.int32)
+        for row, (slot, req) in enumerate(batch):
+            prompts[row, : req.prompt_len] = req.prompt
+            lens[row] = req.prompt_len
+            slot_ids[row] = slot
+        t0 = time.perf_counter()
+        self.pool, first, ok = self._prefill(
+            self.params, self.pool, jnp.asarray(prompts),
+            jnp.asarray(lens), jnp.asarray(slot_ids), self._split_key(),
+        )
+        first = jax.device_get(first)
+        if not bool(ok):
+            self.pool_ok_failures += 1
+        wall = time.perf_counter() - t0
+        self._prefills += 1
+        self._advance(self.tick_s)
+        now = self.now()
+        for row, (slot, req) in enumerate(batch):
+            req.admitted_t = now
+            self.slots[slot] = req
+            self._reserved[slot] = self._pages_needed(req)
+            self.admitted += 1
+            self._emit_token(slot, req, int(first[row]), now)
+            req.first_token_t = now
+            self.ttft_s.append(now - req.arrival_t)
+        self._track_pages()
+        flight.record(
+            kind="serve_prefill", step=self._prefills, wall_s=round(wall, 6),
+            admitted=len(batch), queue=len(self.queue),
+        )
+
+    def _emit_token(self, slot: int, req: Request, tok: int,
+                    now: float) -> None:
+        req.tokens.append(tok)
+        self._slot_last_tok[slot] = tok
+        self.generated_tokens += 1
+        if (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_id is not None and tok == self.eos_id)):
+            req.done_t = now
+            self.completed += 1
+            self.done.append(req)
+            self.slots[slot] = None
+            self._reserved[slot] = 0
+            self._release_mask[slot] = True
+            # the device keeps this sequence's pages until the release
+            # flush; mirror them so peak accounting can't miss a
+            # request that completed the same iteration it prefilled
+            written = req.prompt_len + len(req.tokens) - 1
+            self._pending_pages[slot] = min(
+                -(-written // self.page_len) if written else 0,
+                self.pages_per_seq,
+            )
+
+    def _run_decode_tick(self) -> None:
+        from ddl25spring_tpu.obs import flight
+
+        toks = jnp.asarray(
+            np.asarray(self._slot_last_tok, np.int32)
+        )
+        t0 = time.perf_counter()
+        self.pool, new_tok, ok = self._tick(
+            self.params, self.pool, toks, self._split_key()
+        )
+        new_tok = jax.device_get(new_tok)
+        wall = time.perf_counter() - t0
+        if not bool(ok):
+            self.pool_ok_failures += 1
+        self.tick_wall_s.append(wall)
+        self._ticks += 1
+        self._advance(self.tick_s)
+        now = self.now()
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                self._emit_token(slot, req, int(new_tok[slot]), now)
+        self._track_pages()
+        if self._ticks % 8 == 0 or self._ticks <= 2:
+            flight.record(
+                kind="serve_tick", step=self._ticks,
+                wall_s=round(wall, 6),
+                active=sum(r is not None for r in self.slots),
+                queue=len(self.queue),
+                pages_used=self._host_pages_used(),
+            )
+
+    def _host_pages_used(self) -> int:
+        """Exact host mirror of the device free mask: pages a slot has
+        actually allocated so far (grows lazily page by page).  The
+        newest sampled token is NOT yet written — its KV lands during
+        the next decode tick — so an active slot's written positions
+        are ``prompt + generated - 1``; completed slots keep their
+        pages until the release flush (``_pending_pages``)."""
+        used = 0
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                used += self._pending_pages[slot]
+                continue
+            written = req.prompt_len + max(len(req.tokens) - 1, 0)
+            used += min(
+                -(-written // self.page_len) if written else 0,
+                self.pages_per_seq,
+            )
+        return used
+
+    def _track_pages(self) -> None:
+        self.peak_pages = max(self.peak_pages, self._host_pages_used())
+
+    def _flush_releases(self) -> None:
+        if not any(self._release_mask):
+            return
+        self.pool = self._release(
+            self.pool, jnp.asarray(np.asarray(self._release_mask))
+        )
+        self._release_mask = [False] * self.max_slots
+        self._pending_pages = [0] * self.max_slots
+
+    def step(self) -> bool:
+        """One scheduler iteration: flush releases, admit + prefill,
+        then one packed decode tick.  Returns True when any program
+        ran (False = fully idle)."""
+        ran = False
+        self._flush_releases()
+        self.queue_depths.append(len(self.queue))
+        batch = self._admittable()
+        if batch:
+            self._run_prefill(batch)
+            ran = True
+        # a request that completed DURING prefill (max_new=1 or an eos
+        # first token) must not ride through the decode tick with its
+        # device slot still active — it would write KV for a dead
+        # sequence and could lazily allocate a page the admission
+        # accounting and the host peak mirror never see
+        self._flush_releases()
+        if any(r is not None for r in self.slots):
+            self._run_decode_tick()
+            ran = True
+        self.token_log.append((self.now(), self.generated_tokens))
+        return ran
+
+    def tokens_at(self, t: float) -> int:
+        """Cumulative generated tokens delivered by time ``t`` (engine
+        clock) — the A/B's fixed-budget readout."""
+        out = 0
+        for when, n in self.token_log:
+            if when > t:
+                break
+            out = n
+        return out
+
+    # ---- open-loop run -------------------------------------------------
+
+    def run(
+        self,
+        trace: list[dict],
+        *,
+        budget_s: float | None = None,
+        max_steps: int | None = None,
+    ) -> dict[str, Any]:
+        """Drive the engine under an open-loop arrival trace (each entry
+        ``{"t", "prompt", "max_new"}`` — :mod:`ddl25spring_tpu.serve.
+        traffic`).  Arrivals are submitted when their time comes whether
+        or not the engine kept up (that is what "open loop" means);
+        the run ends at the wall/virtual ``budget_s``, after
+        ``max_steps`` scheduler iterations, or when everything arrived,
+        drained, and completed.  Returns :meth:`metrics`."""
+        arrivals = sorted(trace, key=lambda r: r["t"])
+        i = 0
+        steps = 0
+        while True:
+            now = self.now()
+            if budget_s is not None and now >= budget_s:
+                break
+            if max_steps is not None and steps >= max_steps:
+                break
+            while i < len(arrivals) and arrivals[i]["t"] <= now:
+                a = arrivals[i]
+                self.submit(self.make_request(
+                    a["prompt"], a["max_new"], arrival_t=a["t"]
+                ))
+                i += 1
+            idle = (not self.queue
+                    and all(r is None for r in self.slots))
+            if idle:
+                if i >= len(arrivals):
+                    break  # drained
+                gap = arrivals[i]["t"] - now
+                if self.clock == "virtual":
+                    self._vtime = arrivals[i]["t"]
+                else:
+                    time.sleep(min(max(gap, 0.0), 0.05))
+                continue
+            self.step()
+            steps += 1
+        return self.metrics(budget_s=budget_s)
+
+    # ---- telemetry -----------------------------------------------------
+
+    def metrics(self, budget_s: float | None = None) -> dict[str, Any]:
+        """The ``telemetry.serve`` cell: throughput, tail latency,
+        admission counters, and pool occupancy — every key the BENCH
+        contract (and ``tools/serve_report.py``) reads."""
+
+        def pct(xs, q):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            k = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+            return xs[k]
+
+        wall = self.now()
+        try:  # the chips the pool actually lives on (1 off-mesh)
+            n_chips = max(1, len(self.pool["seq_len"].devices()))
+        except Exception:  # noqa: BLE001 — older array APIs
+            n_chips = 1
+        tok_lat = self.tick_wall_s if self.clock == "wall" else [
+            self.tick_s
+        ] * max(self._ticks, 0)
+        return {
+            "admission": self.admission,
+            "wall_s": round(wall, 4),
+            **({"budget_s": budget_s} if budget_s is not None else {}),
+            "ticks": self._ticks,
+            "prefills": self._prefills,
+            "admitted": self.admitted,
+            "rejected": sum(self.rejected.values()),
+            "rejected_by_reason": dict(self.rejected),
+            "completed": self.completed,
+            "generated_tokens": self.generated_tokens,
+            "tokens_per_sec": (
+                round(self.generated_tokens / wall, 3) if wall > 0 else None
+            ),
+            "tokens_per_sec_per_chip": (
+                round(self.generated_tokens / wall / n_chips, 3)
+                if wall > 0 else None
+            ),
+            "n_chips": n_chips,
+            "ttft_s_p50": pct(self.ttft_s, 50),
+            "ttft_s_p95": pct(self.ttft_s, 95),
+            "tok_latency_s_p50": pct(tok_lat, 50),
+            "tok_latency_s_p95": pct(tok_lat, 95),
+            "queue_depth_max": max(self.queue_depths, default=0),
+            "queue_depth_p50": pct(self.queue_depths, 50),
+            "page_pool_pages": self.n_pages,
+            "page_pool_peak_pages": self.peak_pages,
+            "page_pool_peak_occupancy": round(
+                self.peak_pages / self.n_pages, 4
+            ),
+            "pool_ok_failures": self.pool_ok_failures,
+            "config": {
+                "page_len": self.page_len,
+                "pages_per_seq": self.pages_per_seq,
+                "max_slots": self.max_slots,
+                "prefill_batch": self.prefill_batch,
+                "max_prompt_len": self.max_prompt_len,
+                "max_queue": self.max_queue,
+                "token_budget": self.token_budget,
+                "clock": self.clock,
+            },
+        }
+
+
+# ------------------------------------------------------ registry hook
+
+
+def make_tp_serve_program(
+    cfg: LlamaConfig,
+    mesh,
+    program: str,
+    *,
+    page_len: int = 4,
+    pages_per_seq: int = 4,
+    max_slots: int = 4,
+    max_prompt_len: int = 8,
+    model_axis: str = "model",
+    temperature: float = 0.0,
+    sentinel: bool | None = False,
+):
+    """The TP-sharded serving program: ``(fn, pool, pool_specs)``.
+
+    Params carry the training-side TP layout (:func:`ddl25spring_tpu.
+    parallel.tp.tp_param_specs`, ``shard_vocab=False`` — embed/unembed
+    replicated: sampling is a global decision and decode-shape logits
+    are tiny), the page pool's HEAD dim shards over ``model_axis`` (each
+    shard caches its local ``H/t`` heads), and the per-token
+    communication is exactly the two row-parallel psums per block.
+    ``pool`` is the freshly-initialized GLOBAL pool placed on the mesh;
+    thread it through calls like the single-device engine does."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl25spring_tpu.parallel.tp import tp_param_specs
+    from ddl25spring_tpu.utils.compat import pcast, shard_map
+
+    if program not in ("decode", "prefill"):
+        raise ValueError(f"program={program!r} is not 'decode'/'prefill'")
+    t = int(mesh.shape[model_axis])
+    if cfg.num_heads % t:
+        raise ValueError(f"{cfg.num_heads} heads not divisible by t={t}")
+    n_pages = max_slots * pages_per_seq
+    pool = kv_pages.init_page_pool(
+        cfg, n_pages=n_pages, page_len=page_len, max_slots=max_slots,
+        pages_per_seq=pages_per_seq,
+    )
+    kv_spec = P(None, None, None, model_axis)  # heads sharded
+    pool_specs = {
+        k: (kv_spec if k in ("k", "v") else P()) for k in pool
+    }
+    pool = {
+        k: jax.device_put(v, NamedSharding(mesh, pool_specs[k]))
+        for k, v in pool.items()
+    }
+    p_specs = tp_param_specs(model_axis, False, 0)
+    tp_axis = model_axis if t > 1 else None
+
+    if program == "decode":
+        body = make_decode_tick(
+            cfg, temperature=temperature, tp_axis=tp_axis,
+            sentinel=sentinel,
+        )
+        in_specs = (p_specs, pool_specs, P(), P())
+    else:
+        body = make_prefill(
+            cfg, max_prompt_len=max_prompt_len, temperature=temperature,
+            tp_axis=tp_axis, sentinel=sentinel,
+        )
+        in_specs = (p_specs, pool_specs, P(), P(), P(), P())
+
+    def wrapped(params, pool, *rest):
+        if tp_axis is not None:
+            # the cache starts invariant (zeros) but becomes tp-varying
+            # at the first head-slice write — same re-typing as
+            # models/decode.generate's `vary` (identity shim pre-VMA)
+            pool = {
+                **pool,
+                "k": pcast(pool["k"], (tp_axis,), to="varying"),
+                "v": pcast(pool["v"], (tp_axis,), to="varying"),
+            }
+        return body(params, pool, *rest)
+
+    fn = jax.jit(shard_map(
+        wrapped, mesh=mesh, in_specs=in_specs,
+        out_specs=(pool_specs, P(), P()),
+    ))
+    return fn, pool, pool_specs
+
+
+def describe(mesh, program: str = "decode", model_axis: str = "model"):
+    """Compile-analytics/graft-lint hook for the serving programs
+    (:data:`ddl25spring_tpu.obs.xla_analytics.STRATEGIES` entries
+    ``serve-decode`` / ``serve-prefill``): the TP-sharded decode tick /
+    prefill lowered exactly as the engine builds them.
+
+    The load-bearing signature: TP serving traffic is the row-parallel
+    **all-reduce ONLY** — 2 psums per block per token position, every
+    group strictly over the model axis; permutes / all-gathers /
+    reduce-scatters / all-to-alls are forbidden outright (serve keeps
+    embed/unembed replicated — ``shard_vocab=False`` — so not even the
+    logits assembly gather exists).  Peak-HBM budgets ride along like
+    every training strategy's."""
+    from ddl25spring_tpu.parallel.tp import shard_tp_params
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=2, ctx_size=16,
+        dtype="float32",
+    )
+    t = int(mesh.shape[model_axis])
+    page_len, pages_per_seq, max_slots = 4, 4, 4
+    max_prompt_len = 8
+    prefill_batch = 2
+
+    params = shard_tp_params(
+        llama.init_llama_params(jax.random.PRNGKey(0), cfg), mesh,
+        model_axis, shard_vocab=False,
+    )
+    fn, pool, _specs = make_tp_serve_program(
+        cfg, mesh, program, page_len=page_len,
+        pages_per_seq=pages_per_seq, max_slots=max_slots,
+        max_prompt_len=max_prompt_len, model_axis=model_axis,
+        sentinel=False,
+    )
+    if program == "decode":
+        args = (
+            params, pool,
+            jnp.ones((max_slots,), jnp.int32),
+            jax.random.PRNGKey(1),
+        )
+        # one token position: 2 row-parallel psums per block
+        ar_count = 2 * cfg.n_layers
+        lowered = "decode_step"
+    else:
+        args = (
+            params, pool,
+            jnp.ones((prefill_batch, max_prompt_len), jnp.int32),
+            jnp.full((prefill_batch,), max_prompt_len, jnp.int32),
+            jnp.arange(prefill_batch, dtype=jnp.int32),
+            jax.random.PRNGKey(1),
+        )
+        # every prompt position runs the block stack
+        ar_count = 2 * cfg.n_layers * max_prompt_len
+        lowered = "prefill_step"
+
+    expected: dict[str, Any] = {
+        "scalar_bytes": 64,
+        "forbidden": [
+            "collective-permute", "all-gather", "reduce-scatter",
+            "all-to-all", "collective-broadcast",
+        ],
+        # measured ~47 KiB on this jax/XLA (tiny cfg); generous headroom
+        # for layout churn while still catching a duplicated pool or a
+        # densified gather (the pool alone would blow 256 KiB many times
+        # over if double-buffered at real sizes)
+        "memory": {"max_peak_hbm_bytes": 256 * 1024},
+    }
+    if t > 1:
+        expected["all-reduce"] = {
+            "count": ar_count,
+            "axes": [model_axis],
+        }
+    else:
+        expected["forbidden"].append("all-reduce")
+    return {
+        "fn": fn,
+        "args": args,
+        "lowered": lowered,
+        "meta": {
+            "program": program,
+            "page_len": page_len,
+            "pages_per_seq": pages_per_seq,
+            "max_slots": max_slots,
+            "n_pages": max_slots * pages_per_seq,
+            "tp": t,
+            **({"max_prompt_len": max_prompt_len,
+                "prefill_batch": prefill_batch}
+               if program == "prefill" else {}),
+        },
+        "expected": expected,
+    }

@@ -1,0 +1,209 @@
+"""Paged KV cache: a fixed page pool + per-sequence page tables.
+
+The dense decode cache (:func:`ddl25spring_tpu.models.decode.init_kv_cache`)
+pins ``[L, B, max_len, H, hd]`` per *batch slot* for the whole run — a
+sequence that finishes early keeps its full ``max_len`` slab resident
+until the batch drains, which is exactly what kills continuous batching:
+freed capacity never returns to the pool.  This module is the vLLM-style
+alternative, TPU-first (every operation static-shaped under jit):
+
+- **page pool** ``k/v: [n_pages + 1, L, page_len, H, hd]`` — one shared
+  arena of fixed-size pages, all layers of a page row together (one
+  gather per layer serves a sequence's whole context).  The LAST row is
+  a trash page: masked writes (inactive slots, padded prefill rows) land
+  there instead of corrupting live pages, so no ``lax.cond`` is ever
+  needed on the write path.
+- **page tables** ``[max_slots, pages_per_seq]`` int32 — slot s's page
+  ``j`` holds its positions ``[j*page_len, (j+1)*page_len)``; ``-1``
+  marks an unassigned entry.
+- **allocate / append / free under jit**: batched first-fit allocation
+  (argsort over the free mask; each needy slot takes the next free
+  page), scatter writes at ``(page, layer, offset)``, and slot release
+  that returns every page of a finished sequence to the pool in one
+  scatter — continuous batching's whole point.
+
+Equivalence contract (pinned in ``tests/test_serve.py``): attention
+through the gathered page view is the SAME einsum over the SAME values
+as the dense cache when ``pages_per_seq * page_len == max_len`` — pages
+are gathered in table order, so position ``p`` lands at row ``p`` of the
+view; dead entries are masked with the identical ``-1e30`` fill before
+softmax.  In fp32 the paged decode therefore reproduces the dense
+decode *bitwise*, token for token.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# shared head-count validation with the dense cache layout — defined in
+# models/ (the layer below) so the dependency points downward only
+from ddl25spring_tpu.models.decode import resolve_heads
+from ddl25spring_tpu.utils.config import LlamaConfig
+
+Pool = dict[str, Any]
+
+__all__ = [
+    "resolve_heads", "init_page_pool", "pool_geometry", "reserve_pages",
+    "write_page_ids", "append_layer_kv",
+    "release_slots", "activate_slots", "used_pages",
+]
+
+
+def init_page_pool(
+    cfg: LlamaConfig,
+    *,
+    n_pages: int,
+    page_len: int,
+    max_slots: int,
+    pages_per_seq: int,
+    num_heads: int | None = None,
+) -> Pool:
+    """Build an empty pool.  ``k``/``v`` carry ``n_pages + 1`` rows —
+    row ``n_pages`` is the trash page masked writes target; it is never
+    entered into a page table and never counted as capacity."""
+    if n_pages < 1 or page_len < 1 or max_slots < 1 or pages_per_seq < 1:
+        raise ValueError(
+            f"n_pages={n_pages}, page_len={page_len}, "
+            f"max_slots={max_slots}, pages_per_seq={pages_per_seq}: "
+            "every pool dimension must be >= 1"
+        )
+    heads = resolve_heads(cfg, num_heads)
+    shape = (n_pages + 1, cfg.n_layers, page_len, heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "page_table": jnp.full((max_slots, pages_per_seq), -1, jnp.int32),
+        "seq_len": jnp.zeros((max_slots,), jnp.int32),
+        "active": jnp.zeros((max_slots,), bool),
+        "free": jnp.ones((n_pages,), bool),
+    }
+
+
+def pool_geometry(pool: Pool) -> dict[str, int]:
+    """Static shape facts host code sizes its accounting from."""
+    n_pages = int(pool["free"].shape[0])
+    max_slots, pages_per_seq = (int(d) for d in pool["page_table"].shape)
+    page_len = int(pool["k"].shape[2])
+    return {
+        "n_pages": n_pages,
+        "page_len": page_len,
+        "max_slots": max_slots,
+        "pages_per_seq": pages_per_seq,
+        "max_seq_len": pages_per_seq * page_len,
+    }
+
+
+# --------------------------------------------------------- jit-safe ops
+#
+# Everything below is pure pool -> pool with static shapes, safe inside
+# jit/scan/shard_map.  Masked scatters use mode="drop" with an
+# out-of-bounds sentinel index instead of lax.cond — rows that must not
+# write simply fall off the end.
+
+
+def reserve_pages(pool: Pool, slots: jax.Array, pos: jax.Array,
+                  need: jax.Array):
+    """Batched first-fit allocation: every row ``i`` with ``need[i]``
+    set gets the next free page, entered into ``page_table[slots[i]]``
+    at the entry position ``pos[i]`` calls for (``pos // page_len`` —
+    passed explicitly because prefill allocates at positions its slots'
+    ``seq_len`` does not reach until the prompt is fully written).
+
+    Returns ``(pool, ok)`` — ``ok`` is False when the pool cannot cover
+    the request, in which case NOTHING is allocated (admission control
+    should have prevented this; the flag is the device-side backstop the
+    engine surfaces as a pool-exhaustion event)."""
+    free = pool["free"]
+    n_pages = free.shape[0]
+    P = pool["page_table"].shape[1]
+    page_len = pool["k"].shape[2]
+
+    need = need.astype(bool)
+    # free page ids first, ascending (stable argsort over the negated
+    # mask); row i's candidate page is the rank-th free one
+    order = jnp.argsort(~free, stable=True)
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+    entry = pos // page_len
+    # a needed row whose position falls past the page table fails the
+    # WHOLE call: consuming its page from the free mask while the table
+    # write drop-routes would leak the page forever (in no table, so
+    # release_slots can never return it)
+    ok = (jnp.sum(need) <= jnp.sum(free)) & jnp.all((entry < P) | ~need)
+    pages = order[jnp.clip(rank, 0, n_pages - 1)]
+    take = need & ok
+
+    free = free.at[jnp.where(take, pages, n_pages)].set(False, mode="drop")
+    table = pool["page_table"].at[
+        jnp.where(take, slots, pool["page_table"].shape[0]),
+        jnp.clip(entry, 0, P - 1),
+    ].set(pages, mode="drop")
+    return {**pool, "free": free, "page_table": table}, ok
+
+
+def write_page_ids(pool: Pool, slots: jax.Array, pos: jax.Array,
+                   valid: jax.Array):
+    """``(pages, offsets)`` for writing position ``pos`` of each slot:
+    invalid rows (inactive slot, padded prefill row, position past the
+    table) are routed to the trash page."""
+    n_pages = pool["free"].shape[0]
+    P = pool["page_table"].shape[1]
+    page_len = pool["k"].shape[2]
+    entry = pos // page_len
+    rows = jnp.clip(slots, 0, pool["page_table"].shape[0] - 1)
+    pages = pool["page_table"][rows, jnp.clip(entry, 0, P - 1)]
+    good = valid.astype(bool) & (pages >= 0) & (entry < P)
+    return jnp.where(good, pages, n_pages), pos % page_len
+
+
+def append_layer_kv(k_pages, v_pages, layer, pages, offs, k, v):
+    """Scatter one layer's single-token k/v ``[B, H, hd]`` into the pool
+    at ``(pages[b], layer, offs[b])``.  Trash-routed rows may collide;
+    the trash page is never read, so the nondeterministic overwrite
+    order there is irrelevant."""
+    return (
+        k_pages.at[pages, layer, offs].set(k),
+        v_pages.at[pages, layer, offs].set(v),
+    )
+
+
+def release_slots(pool: Pool, slot_mask: jax.Array) -> Pool:
+    """Free every page of the masked slots and reset their tables —
+    finished sequences return their capacity to the pool (the operation
+    the dense ``[B, max_len]`` slab cannot express)."""
+    n_pages = pool["free"].shape[0]
+    rows = pool["page_table"]
+    freed = slot_mask[:, None].astype(bool) & (rows >= 0)
+    free = pool["free"].at[
+        jnp.where(freed, jnp.clip(rows, 0, n_pages - 1), n_pages)
+    ].set(True, mode="drop")
+    table = jnp.where(slot_mask[:, None], jnp.int32(-1), rows)
+    return {
+        **pool,
+        "free": free,
+        "page_table": table,
+        "seq_len": jnp.where(slot_mask, 0, pool["seq_len"]),
+        "active": pool["active"] & ~slot_mask.astype(bool),
+    }
+
+
+def activate_slots(pool: Pool, slots: jax.Array, valid: jax.Array) -> Pool:
+    """Mark ``slots`` (rows where ``valid``) active with ``seq_len`` 0 —
+    the prefill program's first act.  Assumes the engine hands out only
+    released slots (their tables are already ``-1``)."""
+    S = pool["seq_len"].shape[0]
+    sent = jnp.where(valid.astype(bool), slots, S)
+    return {
+        **pool,
+        "active": pool["active"].at[sent].set(True, mode="drop"),
+        "seq_len": pool["seq_len"].at[sent].set(0, mode="drop"),
+    }
+
+
+def used_pages(pool: Pool) -> jax.Array:
+    """Pages currently allocated (trash excluded) — the occupancy the
+    serving telemetry tracks."""
+    return jnp.sum(~pool["free"])

@@ -1,0 +1,106 @@
+"""Seeded synthetic open-loop serving workload.
+
+Serving benchmarks need *open-loop* arrivals (requests land on their own
+clock whether or not the engine kept up — the regime where admission
+control and tail latency actually mean something), reproducibly: two
+runs of ``bench.py --serve`` on the same seed must replay the identical
+trace, or the continuous-vs-static A/B and the cross-run ledger trend
+compare different workloads.
+
+- **Poisson arrivals** with a time-varying rate: inter-arrival gaps are
+  drawn by thinning a homogeneous process at the profile's peak rate
+  (the standard non-homogeneous Poisson recipe), so any ramp profile
+  stays a true Poisson process at every instant.
+- **Ramp profiles**: ``flat`` (constant), ``ramp`` (linear 0.1x -> 1x —
+  the warm-up shape the CI smoke drives), ``spike`` (1/3 at 0.3x, 1/3
+  at 1x, 1/3 at 0.3x — the overload shape that exercises queue
+  backpressure and rejections).
+- **Length mixes**: a categorical over ``(prompt_len, max_new)`` pairs
+  (chat-style short-in/long-out next to retrieval-style long-in/
+  short-out), prompt token ids drawn uniformly from ``[1, vocab)``
+  (0 is pad by convention).
+
+Everything is host-side numpy off one ``RandomState(seed)`` — no jax,
+no device."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+# (prompt_len, max_new_tokens, weight)
+DEFAULT_MIX: tuple[tuple[int, int, float], ...] = (
+    (4, 8, 0.5),    # chat-style: short prompt, longer generation
+    (8, 4, 0.3),    # retrieval-style: longer prompt, short answer
+    (6, 6, 0.2),
+)
+
+PROFILES = ("flat", "ramp", "spike")
+
+
+@dataclass(frozen=True)
+class TrafficSpec:
+    """One reproducible workload: rate shape + length mix + seed."""
+
+    seed: int = 0
+    duration_s: float = 4.0
+    rate_rps: float = 4.0          # peak arrival rate (requests/sec)
+    profile: str = "ramp"
+    mix: tuple[tuple[int, int, float], ...] = field(default=DEFAULT_MIX)
+    vocab_size: int = 64
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate lambda(t) of the profile."""
+        if self.profile == "flat":
+            return self.rate_rps
+        frac = t / self.duration_s if self.duration_s > 0 else 0.0
+        if self.profile == "ramp":
+            return self.rate_rps * (0.1 + 0.9 * min(max(frac, 0.0), 1.0))
+        if self.profile == "spike":
+            return self.rate_rps * (1.0 if 1 / 3 <= frac < 2 / 3 else 0.3)
+        raise ValueError(
+            f"profile {self.profile!r} is not one of {PROFILES}"
+        )
+
+
+def synth_trace(spec: TrafficSpec) -> list[dict[str, Any]]:
+    """Materialize the arrival trace: ``[{"t", "prompt", "max_new"}]``
+    sorted by arrival time, deterministic in ``spec.seed``.
+
+    Thinning: candidate gaps are exponential at the PEAK rate; each
+    candidate is kept with probability ``lambda(t)/peak`` — the kept
+    points are a Poisson process with intensity ``lambda(t)``."""
+    if spec.rate_rps <= 0 or spec.duration_s <= 0:
+        return []
+    rng = np.random.RandomState(spec.seed)
+    weights = np.asarray([w for _, _, w in spec.mix], np.float64)
+    weights = weights / weights.sum()
+    out: list[dict[str, Any]] = []
+    peak = max(spec.rate_at(t) for t in np.linspace(
+        0.0, spec.duration_s, 64
+    ))
+    peak = max(peak, 1e-9)
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / peak))
+        if t >= spec.duration_s:
+            break
+        if rng.uniform() > spec.rate_at(t) / peak:
+            continue  # thinned: the profile is below peak here
+        p_len, max_new, _ = spec.mix[int(rng.choice(len(spec.mix),
+                                                    p=weights))]
+        prompt = rng.randint(1, spec.vocab_size, size=int(p_len))
+        out.append({
+            "t": round(t, 6),
+            "prompt": [int(x) for x in prompt],
+            "max_new": int(max_new),
+        })
+    return out
+
+
+def trace_tokens(trace: list[dict[str, Any]]) -> int:
+    """Total prompt+output tokens the trace asks for — what the
+    admission token budget is sized against."""
+    return sum(len(r["prompt"]) + r["max_new"] for r in trace)

@@ -40,10 +40,11 @@ DEFAULT_TOLERANCE = 0.35
 DEFAULT_WINDOW = 5
 
 
-def read_ledger(path: str) -> list[dict]:
-    """Parseable perf records in append order (torn lines skipped) —
-    same contract as ``perfscope.read_ledger``, restated here so the
-    gate never imports jax."""
+def read_ledger(path: str, kind: str = "perf") -> list[dict]:
+    """Parseable ``record: kind`` rows in append order (torn lines
+    skipped) — same contract as ``perfscope.read_ledger``, restated
+    here so the gate never imports jax.  ``serve_report.py`` reads the
+    same ledger with ``kind="serve"``."""
     out: list[dict] = []
     p = Path(path)
     if not p.exists():
@@ -56,7 +57,7 @@ def read_ledger(path: str) -> list[dict]:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if isinstance(rec, dict) and rec.get("record") == "perf":
+        if isinstance(rec, dict) and rec.get("record") == kind:
             out.append(rec)
     return out
 
@@ -74,10 +75,11 @@ def ledger_key(rec: dict) -> tuple[str, str, str]:
     )
 
 
-def group_records(records: list[dict]) -> dict[tuple, list[dict]]:
+def group_records(records: list[dict], key=None) -> dict[tuple, list[dict]]:
+    key = key or ledger_key
     groups: dict[tuple, list[dict]] = {}
     for rec in records:
-        groups.setdefault(ledger_key(rec), []).append(rec)
+        groups.setdefault(key(rec), []).append(rec)
     return groups
 
 

@@ -1,0 +1,355 @@
+"""Render a serving run and gate cross-run serving regressions.
+
+    python tools/serve_report.py runs/serve_smoke        # run report
+    python tools/serve_report.py --ledger-only           # trend tables
+    python tools/serve_report.py runs/serve_smoke --check   # the CI gate
+    python tools/serve_report.py --check --check-ab      # + A/B verdict
+
+The run directory holds the ``serve.json`` a ``bench.py --serve``
+run dropped there (``ddl25spring_tpu/serve/driver.py``): the report
+renders its throughput/admission table and ASCII latency histograms
+(TTFT and per-decode-tick wall time).  The ledger
+(``runs/perf_ledger.jsonl``) additionally holds one ``record: "serve"``
+trend row per run, keyed (workload key, host) with git sha as the
+variable under test — the same ledger the perfscope records live in,
+different record kind.
+
+``--check`` mirrors ``perf_report.py``: exit non-zero when, within any
+(key, host) group, the LATEST row regresses past the tolerance band
+against the median of up to ``--window`` priors — tokens/sec/chip
+falling by more than ``--tolerance`` (fractional, default 0.5 — CPU CI
+wall clocks are noisy) or p95 TTFT growing by more than it.  Groups
+with a single row pass with a "no baseline yet" note, and rows from
+different hosts never gate each other.  ``--check-ab`` adds the
+continuous-batching acceptance verdict: the latest row's A/B cell must
+show continuous strictly ahead of static in tokens delivered at the
+fixed budget (the deterministic virtual-clock comparison the driver
+records).
+
+Pure stdlib — no jax import, so the gate runs anywhere the JSON does.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from datetime import datetime, timezone
+from pathlib import Path
+
+# the torn-tail ledger contract, grouping, and number formatting are
+# perf_report's — one implementation for every stdlib gate over
+# runs/perf_ledger.jsonl
+try:  # imported as tools.serve_report (tests, package contexts)
+    from tools import perf_report as _perf_report
+except ImportError:  # run as a script: sys.path[0] is tools/
+    import perf_report as _perf_report
+
+_fmt = _perf_report._fmt
+_median = _perf_report._median
+
+DEFAULT_LEDGER = "runs/perf_ledger.jsonl"
+DEFAULT_TOLERANCE = 0.5
+DEFAULT_WINDOW = 5
+# restated from ddl25spring_tpu.obs.report so the gate never imports
+# the package (or numpy/jax behind it)
+SERVE_BASENAME = "serve.json"
+
+
+def read_serve_json(run_dir: str) -> dict:
+    p = Path(run_dir) / SERVE_BASENAME
+    with open(p) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("record") != "serve":
+        raise ValueError(f"{p} is not a serve record")
+    return doc
+
+
+def read_ledger(path: str) -> list[dict]:
+    """Parseable ``record: "serve"`` rows in append order (torn
+    trailing lines skipped — ``perf_report.read_ledger``'s contract)."""
+    return _perf_report.read_ledger(path, kind="serve")
+
+
+def ledger_key(rec: dict) -> tuple[str, str]:
+    """(workload key, host): the trend identity.  git sha is the
+    variable under test, so it stays OUT of the key."""
+    key = rec.get("key")
+    key_s = (
+        ",".join(f"{k}={key[k]}" for k in sorted(key))
+        if isinstance(key, dict) else str(key)
+    )
+    return (key_s, str(rec.get("host")))
+
+
+def group_records(records: list[dict]) -> dict[tuple, list[dict]]:
+    return _perf_report.group_records(records, key=ledger_key)
+
+
+def check_group(
+    recs: list[dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+    window: int = DEFAULT_WINDOW,
+) -> list[str]:
+    """Regression verdicts for one (key, host) group: [] = latest within
+    band (or no baseline yet).  Baseline = median of up to ``window``
+    priors — one noisy historical run must not move the gate."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    base = recs[:-1][-window:]
+    fails: list[str] = []
+    b_tps = _median([
+        r["tokens_per_sec_per_chip"] for r in base
+        if isinstance(r.get("tokens_per_sec_per_chip"), (int, float))
+    ])
+    l_tps = latest.get("tokens_per_sec_per_chip")
+    if b_tps and isinstance(l_tps, (int, float)):
+        if l_tps < b_tps * (1.0 - tolerance):
+            fails.append(
+                f"tokens_per_sec_per_chip {l_tps:.2f} fell below the "
+                f"{(1 - tolerance):.2f}x band under the baseline "
+                f"{b_tps:.2f} (median of {len(base)} prior run(s))"
+            )
+    b_ttft = _median([
+        r["ttft_s_p95"] for r in base
+        if isinstance(r.get("ttft_s_p95"), (int, float))
+    ])
+    l_ttft = latest.get("ttft_s_p95")
+    if b_ttft and isinstance(l_ttft, (int, float)):
+        if l_ttft > b_ttft * (1.0 + tolerance):
+            fails.append(
+                f"ttft_s_p95 {l_ttft * 1e3:.2f} ms exceeds the "
+                f"{(1 + tolerance):.2f}x band over the baseline "
+                f"{b_ttft * 1e3:.2f} ms"
+            )
+    return fails
+
+
+def check_ab(recs: list[dict]) -> list[str]:
+    """The continuous-batching acceptance verdict on the latest row:
+    the A/B cell must exist and show continuous STRICTLY ahead."""
+    if not recs:
+        return []
+    ab = recs[-1].get("ab")
+    if not isinstance(ab, dict):
+        return ["latest record carries no A/B cell (run without "
+                "--no-serve-ab to record one)"]
+    adv = ab.get("advantage_tokens")
+    if not isinstance(adv, (int, float)) or adv <= 0:
+        return [
+            f"continuous batching did not beat static at the fixed "
+            f"budget: continuous {ab.get('continuous_tokens_at_budget')} "
+            f"vs static {ab.get('static_tokens_at_budget')} tokens "
+            f"(budget {ab.get('budget_s')} s)"
+        ]
+    return []
+
+
+def histogram(xs: list[float], *, bins: int = 10, width: int = 40,
+              scale: float = 1e3, unit: str = "ms") -> list[str]:
+    """ASCII histogram lines (log-ish readable, linear bins)."""
+    xs = [x for x in xs if isinstance(x, (int, float))]
+    if not xs:
+        return ["  (no samples)"]
+    lo, hi = min(xs), max(xs)
+    span = (hi - lo) or max(abs(hi), 1e-9)
+    counts = [0] * bins
+    for x in xs:
+        i = min(int((x - lo) / span * bins), bins - 1)
+        counts[i] += 1
+    peak = max(counts)
+    out = []
+    for i, c in enumerate(counts):
+        a = lo + span * i / bins
+        b = lo + span * (i + 1) / bins
+        bar = "#" * max(1 if c else 0, round(c / peak * width))
+        out.append(
+            f"  {a * scale:9.3f}-{b * scale:9.3f} {unit} "
+            f"|{bar:<{width}}| {c}"
+        )
+    return out
+
+
+def format_run(doc: dict) -> str:
+    ramp = doc.get("ramp", {})
+    key = doc.get("key", {})
+    lines = [
+        "serving run "
+        + " ".join(f"{k}={key[k]}" for k in sorted(key))
+        + f"  sha {(doc.get('git_sha') or '?')[:7]}",
+        "",
+        f"  requests {doc.get('requests')}  admitted {ramp.get('admitted')}"
+        f"  rejected {ramp.get('rejected')} {ramp.get('rejected_by_reason')}"
+        f"  completed {ramp.get('completed')}",
+        f"  generated tokens {ramp.get('generated_tokens')}"
+        f"  tokens/sec/chip "
+        f"{_fmt(ramp.get('tokens_per_sec_per_chip'), 2)}"
+        f"  (chips {ramp.get('n_chips')}, wall "
+        f"{_fmt(ramp.get('wall_s'), 2)} s)",
+        f"  TTFT p50 {_fmt(ramp.get('ttft_s_p50'), 2, 1e3, ' ms')}"
+        f"  p95 {_fmt(ramp.get('ttft_s_p95'), 2, 1e3, ' ms')}"
+        f"  |  per-token p50 "
+        f"{_fmt(ramp.get('tok_latency_s_p50'), 2, 1e3, ' ms')}"
+        f"  p95 {_fmt(ramp.get('tok_latency_s_p95'), 2, 1e3, ' ms')}",
+        f"  queue depth max {ramp.get('queue_depth_max')}"
+        f"  page pool peak {ramp.get('page_pool_peak_pages')}"
+        f"/{ramp.get('page_pool_pages')} pages "
+        f"({_fmt(ramp.get('page_pool_peak_occupancy'), 1, 100, '%')})"
+        f"  pool-ok failures {ramp.get('pool_ok_failures')}",
+    ]
+    ab = doc.get("ab")
+    if ab:
+        lines += [
+            "",
+            "  continuous-vs-static A/B (virtual clock, tick "
+            f"{_fmt(ab.get('tick_s'), 4)} s, budget "
+            f"{_fmt(ab.get('budget_s'), 3)} s):",
+            f"    continuous {ab.get('continuous_tokens_at_budget')} "
+            f"tokens  static {ab.get('static_tokens_at_budget')} tokens  "
+            f"advantage {ab.get('advantage_tokens')} "
+            f"({_fmt(ab.get('advantage_frac'), 1, 100, '%')})",
+        ]
+    if doc.get("ttft_s"):
+        lines += ["", "  TTFT histogram:"] + histogram(doc["ttft_s"])
+    if doc.get("tick_wall_s"):
+        lines += (
+            ["", "  decode-tick wall histogram:"]
+            + histogram(doc["tick_wall_s"])
+        )
+    return "\n".join(lines)
+
+
+def format_group(key: tuple, recs: list[dict], last: int) -> str:
+    key_s, host = key
+    lines = [f"serve {key_s}  host {host}"]
+    cols = (
+        f"  {'when (utc)':<20}{'sha':<9}{'tok/s/chip':>11}"
+        f"{'ttft p50':>11}{'ttft p95':>11}{'tok p95':>11}"
+        f"{'adm':>5}{'rej':>5}{'pool%':>7}{'ab adv':>8}"
+    )
+    lines.append(cols)
+    lines.append("  " + "-" * (len(cols) - 2))
+    for rec in recs[-last:]:
+        ts = rec.get("ts")
+        when = (
+            datetime.fromtimestamp(ts, tz=timezone.utc)
+            .strftime("%Y-%m-%d %H:%M:%S")
+            if isinstance(ts, (int, float)) else "?"
+        )
+        sha = (rec.get("git_sha") or "?")[:7]
+        ab = rec.get("ab") or {}
+        lines.append(
+            f"  {when:<20}{sha:<9}"
+            f"{_fmt(rec.get('tokens_per_sec_per_chip'), 2):>11}"
+            f"{_fmt(rec.get('ttft_s_p50'), 1, 1e3, 'ms'):>11}"
+            f"{_fmt(rec.get('ttft_s_p95'), 1, 1e3, 'ms'):>11}"
+            f"{_fmt(rec.get('tok_latency_s_p95'), 1, 1e3, 'ms'):>11}"
+            f"{rec.get('admitted', '?'):>5}"
+            f"{rec.get('rejected', '?'):>5}"
+            f"{_fmt(rec.get('page_pool_peak_occupancy'), 0, 100, '%'):>7}"
+            f"{_fmt(ab.get('advantage_tokens'), 0):>8}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", nargs="?", default=None,
+                    help="obs dir holding serve.json (omit with "
+                         "--ledger-only for the trend tables alone)")
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER, metavar="JSONL")
+    ap.add_argument("--ledger-only", action="store_true",
+                    help="skip the run report; render/check the ledger")
+    ap.add_argument("--last", type=int, default=8,
+                    help="rows per key in the trend table")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="prior rows per key the baseline medians over")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="fractional regression band (0.5 = tokens/sec "
+                         "may drop 50%%, p95 TTFT may grow 50%%); CPU CI "
+                         "wall clocks want wide bands")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero when any (key, host) group's "
+                         "latest row regresses past the band (the CI "
+                         "serving gate)")
+    ap.add_argument("--check-ab", action="store_true",
+                    help="also fail when the latest row's "
+                         "continuous-vs-static A/B does not show "
+                         "continuous strictly ahead (implies --check)")
+    args = ap.parse_args(argv)
+    if args.check_ab:
+        args.check = True  # a verdict nobody reads is not a gate
+
+    if args.run_dir is None and not args.ledger_only:
+        ap.error("pass a run_dir, or --ledger-only")
+
+    doc = None
+    if args.run_dir is not None:
+        try:
+            doc = read_serve_json(args.run_dir)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"no serving record at {args.run_dir}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(format_run(doc))
+        print()
+
+    records = read_ledger(args.ledger)
+    if not records:
+        print(f"no serve records in {args.ledger} (run "
+              "bench.py --serve to populate it)", file=sys.stderr)
+        return 2 if args.check else 0
+
+    groups = group_records(records)
+    # with a run_dir the A/B acceptance verdict gates THAT run's
+    # (key, host) group ONLY; other groups' rows may legitimately have
+    # been recorded with --no-serve-ab or hold a documented tie (an
+    # unloaded engine serves both policies identically), and a stale
+    # unrelated key must not wedge the gate forever.  Ledger-only mode
+    # has no run to scope to and stays strict across every group.
+    ab_scope = ledger_key(doc) if doc is not None else None
+    verdicts: dict[tuple, dict] = {}
+    for key, recs in groups.items():
+        fails: list[str] = []
+        note = None
+        if args.check_ab and (ab_scope is None or key == ab_scope):
+            # the A/B verdict needs no baseline: a single row gates
+            fails += check_ab(recs)
+        if len(recs) < 2:
+            if not fails:
+                note = "no baseline yet (single record)"
+        else:
+            fails += check_group(recs, args.tolerance, args.window)
+        verdicts[key] = {"fails": fails, "note": note}
+    if args.check_ab and ab_scope is not None and ab_scope not in groups:
+        # the run under test never landed in this ledger (custom
+        # --ledger path): judge its serve.json directly
+        verdicts[ab_scope] = {"fails": check_ab([doc]), "note": None}
+    bad = sum(len(v["fails"]) for v in verdicts.values())
+
+    print(f"serve ledger: {args.ledger}  ({len(records)} record(s), "
+          f"{len(groups)} key(s))\n")
+    print("\n\n".join(
+        format_group(k, v, args.last) for k, v in groups.items()
+    ))
+
+    if args.check:
+        for key, v in verdicts.items():
+            label = f"serve({key[0][:60]})"
+            if v["note"]:
+                print(f"CHECK NOTE {label}: {v['note']}", file=sys.stderr)
+            for fail in v["fails"]:
+                print(f"CHECK FAIL {label}: {fail}", file=sys.stderr)
+        if bad:
+            return 1
+        ab_note = ", A/B advantage verified" if args.check_ab else ""
+        print(f"\nserve check OK: {len(groups)} key(s) within the "
+              f"{args.tolerance:.2f} tolerance band{ab_note}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
