@@ -605,8 +605,10 @@ def main(argv=None) -> None:
     ap.add_argument("--serve-rate", type=float, default=None, metavar="RPS",
                     help="peak arrival rate (requests/sec)")
     ap.add_argument("--serve-profile", default=None,
-                    choices=("flat", "ramp", "spike"),
-                    help="arrival-rate shape (default ramp)")
+                    choices=("flat", "ramp", "spike", "shared"),
+                    help="arrival-rate shape (default ramp; 'shared' = "
+                         "K seeded system prompts x Poisson arrivals — "
+                         "the radix-prefix-cache workload)")
     ap.add_argument("--serve-seed", type=int, default=None,
                     help="traffic trace seed (two runs on the same seed "
                          "replay the identical workload)")
@@ -618,6 +620,10 @@ def main(argv=None) -> None:
                          "else the reference LLaMA constants)")
     ap.add_argument("--no-serve-ab", action="store_true",
                     help="skip the continuous-vs-static A/B phase")
+    ap.add_argument("--no-serve-prefix-ab", action="store_true",
+                    help="skip the cached-vs-cold prefix-cache A/B "
+                         "phase (it also never runs with "
+                         "DDL25_SERVE_PREFIX=0)")
     ap.add_argument("--compile-report", action="store_true",
                     help="force the pre-device compile report on CPU runs "
                          "(the accelerator path always computes it; see "
@@ -795,6 +801,7 @@ def main(argv=None) -> None:
             budget_s=args.serve_budget,
             ledger_path=args.perf_ledger or "runs/perf_ledger.jsonl",
             skip_ab=args.no_serve_ab,
+            skip_prefix_ab=args.no_serve_prefix_ab,
         )
         telemetry: dict = {
             "enabled": bool(args.obs_dir),

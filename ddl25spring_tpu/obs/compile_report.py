@@ -42,13 +42,16 @@ COMPILE_REPORT_BASENAME = "compile_report.json"
 # zero1/zero2's overlap twins therefore graduated from on-demand to
 # default.  PR 10 adds the two serving programs (serve-decode /
 # serve-prefill: the paged-KV TP inference steps, pinned all-reduce-only
-# like tp but forward-only).  All sixteen share the tests' lower-once
-# compile cache, so tier-1 pays each compile exactly once.
+# like tp but forward-only); PR 11 adds the prefix cache's start-offset
+# prefill variant (serve-prefill-cached), whose SHORTER scan — fewer
+# all-reduces than serve-prefill's — is the compile-time proof of the
+# prefill FLOPs a radix hit skips.  All seventeen share the tests'
+# lower-once compile cache, so tier-1 pays each compile exactly once.
 DEFAULT_STRATEGIES = (
     "dp", "dp-overlap", "zero1", "zero1-overlap", "zero2",
     "zero2-overlap", "zero3", "zero3-prefetch", "zero3-overlap",
     "pipeline", "het_pipeline", "tp", "sp", "ep",
-    "serve-decode", "serve-prefill",
+    "serve-decode", "serve-prefill", "serve-prefill-cached",
 )
 
 

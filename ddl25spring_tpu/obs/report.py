@@ -279,6 +279,7 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 "requests": sdoc.get("requests"),
                 "ramp": sdoc.get("ramp"),
                 "ab": sdoc.get("ab"),
+                "prefix_ab": sdoc.get("prefix_ab"),
                 "git_sha": sdoc.get("git_sha"),
             }
         except (json.JSONDecodeError, OSError) as e:
@@ -464,6 +465,18 @@ def format_report(summary: dict[str, Any]) -> str:
                 + f"  queue depth max {ramp.get('queue_depth_max')}"
                 + f"  pool-ok failures {ramp.get('pool_ok_failures')}"
             )
+            prefix = ramp.get("prefix") or {}
+            if prefix.get("enabled"):
+                hit = ramp.get("prefix_hit_rate")
+                lines.append(
+                    "  prefix cache hit rate "
+                    + (f"{hit * 100:.1f}%" if isinstance(
+                        hit, (int, float)) else "n/a")
+                    + f"  prefill saved {ramp.get('prefill_tokens_saved')}"
+                    f" tokens / {ramp.get('prefill_flops_saved')} FLOPs"
+                    f"  cached pages {prefix.get('cached_pages')}"
+                    f"  evictions {prefix.get('evictions')}"
+                )
             ab = sv.get("ab")
             if ab:
                 lines.append(
@@ -472,6 +485,16 @@ def format_report(summary: dict[str, Any]) -> str:
                     f"{ab.get('static_tokens_at_budget')} tokens at "
                     f"budget {ab.get('budget_s')} s  (advantage "
                     f"{ab.get('advantage_tokens')})"
+                )
+            pab = sv.get("prefix_ab")
+            if pab:
+                lines.append(
+                    "  prefix A/B cached "
+                    f"{pab.get('cached_tokens_at_budget')} vs cold "
+                    f"{pab.get('cold_tokens_at_budget')} tokens at "
+                    f"budget {pab.get('budget_s')} s  (advantage "
+                    f"{pab.get('advantage_tokens')}, tokens match "
+                    f"{pab.get('tokens_match')})"
                 )
 
     c = summary.get("counters", {})

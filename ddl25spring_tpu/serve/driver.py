@@ -69,6 +69,10 @@ def engine_knobs(smoke: bool = False) -> dict[str, Any]:
             env_int("DDL25_SERVE_TOKEN_BUDGET", d["token_budget"]) or None
         ),
         "eos_id": None if eos < 0 else eos,
+        # the radix prefix cache (PR 11): on by default — a workload
+        # with no repeated prefixes simply never hits, and the cold
+        # path is bitwise-identical; 0 disables outright
+        "prefix_cache": bool(env_int("DDL25_SERVE_PREFIX", 1)),
     }
 
 
@@ -166,6 +170,80 @@ def ab_compare(
     return out
 
 
+def prefix_ab_compare(
+    params, cfg, trace, knobs: dict[str, Any], *,
+    tick_s: float | None = None, max_steps: int = 20_000,
+    temperature: float = 0.0, sentinel: bool | None = None,
+) -> dict[str, Any]:
+    """Radix-prefix-cache A/B: the identical trace through a CACHED
+    engine (prefix cache on) and a COLD one (off), both continuous
+    admission on the virtual clock at the same ``prefill_batch =
+    max_slots`` width — equal admission budget, so the only difference
+    is the prefill scan work the radix hits skip.  The virtual clock
+    charges each prefill for the scan it actually ran (``(max_prompt_len
+    - start) / max_prompt_len`` ticks), so the advantage is
+    deterministic on any host: run both to drain, fix the budget at the
+    midpoint of the two drain walls, read tokens-delivered-by-budget
+    off each timeline — exactly the ``ab_compare`` discipline.
+
+    ``tokens_match`` rides along as the correctness half: every request
+    completed by BOTH arms must carry the identical token string
+    (prefix-cached decode reproduces the cold path bitwise in fp32;
+    the full pin — COW boundary, eviction-readmit — lives in
+    ``tests/test_serve_prefix.py``)."""
+    if tick_s is None:
+        tick_s = ab_tick_s(trace, knobs["max_slots"])
+    out: dict[str, Any] = {}
+    engines = {}
+    for arm, cache_on in (("cached", True), ("cold", False)):
+        e = _build_engine(
+            params, cfg, knobs, admission="continuous", clock="virtual",
+            tick_s=tick_s, temperature=temperature, sentinel=sentinel,
+            prefill_batch=knobs["max_slots"], prefix_cache=cache_on,
+        )
+        m = e.run(trace, max_steps=max_steps)
+        engines[arm] = e
+        out[arm] = {
+            "drain_wall_s": m["wall_s"],
+            "ticks": m["ticks"],
+            "prefills": m["prefills"],
+            "generated_tokens": m["generated_tokens"],
+            "completed": m["completed"],
+            "rejected": m["rejected"],
+            "tokens_per_sec_per_chip": m["tokens_per_sec_per_chip"],
+            **({
+                "prefix_hit_rate": m["prefix_hit_rate"],
+                "prefill_tokens_saved": m["prefill_tokens_saved"],
+                "prefill_flops_saved": m["prefill_flops_saved"],
+            } if cache_on else {}),
+        }
+    budget = round(
+        (out["cached"]["drain_wall_s"] + out["cold"]["drain_wall_s"]) / 2,
+        6,
+    )
+    cached = engines["cached"].tokens_at(budget)
+    cold = engines["cold"].tokens_at(budget)
+    streams = {
+        arm: {r.rid: list(r.tokens) for r in e.done}
+        for arm, e in engines.items()
+    }
+    common = set(streams["cached"]) & set(streams["cold"])
+    out.update(
+        budget_s=budget,
+        tick_s=tick_s,
+        cached_tokens_at_budget=cached,
+        cold_tokens_at_budget=cold,
+        advantage_tokens=cached - cold,
+        advantage_frac=round((cached - cold) / cold, 4) if cold else None,
+        tokens_match=all(
+            streams["cached"][rid] == streams["cold"][rid]
+            for rid in common
+        ),
+        compared_requests=len(common),
+    )
+    return out
+
+
 def run_serve_bench(
     *,
     smoke: bool = False,
@@ -180,6 +258,7 @@ def run_serve_bench(
     temperature: float = 0.0,
     sentinel: bool | None = None,
     skip_ab: bool = False,
+    skip_prefix_ab: bool = False,
 ) -> dict[str, Any]:
     """The whole serving bench; returns the BENCH record (one JSON line
     with ``telemetry.serve``).  ``budget_s`` bounds the wall-clock ramp
@@ -225,13 +304,25 @@ def run_serve_bench(
         params, cfg, knobs, clock="wall", temperature=temperature,
         sentinel=sentinel,
     )
-    eng.warmup()  # compile OFF the clock: TTFT measures serving, not XLA
+    # compile OFF the clock: TTFT measures serving, not XLA.  With the
+    # prefix cache on this includes the sharing ops and EVERY
+    # start-offset prefill variant (scan starts are page-quantized, so
+    # the universe is bounded and warmup covers it all)
+    eng.warmup()
     ramp = eng.run(trace, budget_s=budget_s, max_steps=50_000)
 
     # --- continuous-vs-static A/B: virtual clock, deterministic -------
     ab = None
     if not skip_ab:
         ab = ab_compare(
+            params, cfg, trace, knobs,
+            temperature=temperature, sentinel=sentinel,
+        )
+
+    # --- cached-vs-cold prefix A/B: virtual clock, deterministic ------
+    prefix_ab = None
+    if not skip_prefix_ab and knobs.get("prefix_cache"):
+        prefix_ab = prefix_ab_compare(
             params, cfg, trace, knobs,
             temperature=temperature, sentinel=sentinel,
         )
@@ -254,10 +345,19 @@ def run_serve_bench(
             # callback per tick), so on/off rows are different
             # measurements — keyed apart, they never gate each other
             "sentinels": bool(sentinels.resolve(sentinel)[0]),
+            # a prefix-cached engine is a different measurement than a
+            # cold one (the whole point of the PR-11 A/B) — keyed apart
+            "prefix_cache": bool(knobs.get("prefix_cache")),
+            **({
+                "shared_prefixes": spec.shared_prefixes,
+                "shared_prefix_len": spec.shared_prefix_len,
+                "shared_suffix_len": spec.shared_suffix_len,
+            } if spec.profile == "shared" else {}),
         },
         "requests": len(trace),
         "ramp": ramp,
         **({"ab": ab} if ab is not None else {}),
+        **({"prefix_ab": prefix_ab} if prefix_ab is not None else {}),
         # bounded raw samples for serve_report's histogram (the summary
         # percentiles above are what the gates read)
         "ttft_s": [round(x, 6) for x in eng.ttft_s[:512]],
@@ -305,6 +405,12 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
         "rejected": ramp.get("rejected"),
         "completed": ramp.get("completed"),
         "page_pool_peak_occupancy": ramp.get("page_pool_peak_occupancy"),
+        # the radix prefix cache's deterministic counters (None / 0 on
+        # a cold engine) — prefix_hit_rate is a GATED key on
+        # shared-prefix runs (serve_report --check)
+        "prefix_hit_rate": ramp.get("prefix_hit_rate"),
+        "prefill_tokens_saved": ramp.get("prefill_tokens_saved"),
+        "prefill_flops_saved": ramp.get("prefill_flops_saved"),
     }
     ab = record.get("ab")
     if ab:
@@ -316,7 +422,35 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
                 "advantage_frac",
             )
         }
+    pab = record.get("prefix_ab")
+    if pab:
+        out["prefix_ab"] = _prefix_ab_cell(pab)
     return out
+
+
+def _prefix_ab_cell(pab: dict[str, Any]) -> dict[str, Any]:
+    """The prefix A/B summary both the ledger row and telemetry.serve
+    carry — what ``serve_report --check-prefix-ab`` gates."""
+    cached = pab.get("cached") or {}
+    cold = pab.get("cold") or {}
+    return {
+        "budget_s": pab.get("budget_s"),
+        "cached_tokens_at_budget": pab.get("cached_tokens_at_budget"),
+        "cold_tokens_at_budget": pab.get("cold_tokens_at_budget"),
+        "advantage_tokens": pab.get("advantage_tokens"),
+        "advantage_frac": pab.get("advantage_frac"),
+        "tokens_match": pab.get("tokens_match"),
+        "compared_requests": pab.get("compared_requests"),
+        "cached_tokens_per_sec_per_chip": cached.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "cold_tokens_per_sec_per_chip": cold.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "prefix_hit_rate": cached.get("prefix_hit_rate"),
+        "prefill_tokens_saved": cached.get("prefill_tokens_saved"),
+        "prefill_flops_saved": cached.get("prefill_flops_saved"),
+    }
 
 
 def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
@@ -342,6 +476,10 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
         "n_chips": ramp.get("n_chips"),
         "requests": record.get("requests"),
         "key": record.get("key"),
+        "prefix_hit_rate": ramp.get("prefix_hit_rate"),
+        "prefill_tokens_saved": ramp.get("prefill_tokens_saved"),
+        "prefill_flops_saved": ramp.get("prefill_flops_saved"),
+        "prefix": ramp.get("prefix"),
     }
     ab = record.get("ab")
     if ab:
@@ -354,6 +492,9 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
             "advantage_tokens": ab.get("advantage_tokens"),
             "advantage_frac": ab.get("advantage_frac"),
         }
+    pab = record.get("prefix_ab")
+    if pab:
+        cell["prefix_ab"] = _prefix_ab_cell(pab)
     for k in ("ledger", "ledger_error", "serve_json"):
         if record.get(k):
             cell[k] = record[k]

@@ -47,6 +47,7 @@ from jax import lax
 from ddl25spring_tpu.models import decode as decode_mod, llama
 from ddl25spring_tpu.obs import sentinels
 from ddl25spring_tpu.serve import kv_pages
+from ddl25spring_tpu.serve.prefix import Match, PrefixCache
 from ddl25spring_tpu.utils.config import LlamaConfig
 
 Params = dict[str, Any]
@@ -214,6 +215,7 @@ def make_prefill(
     cfg: LlamaConfig,
     *,
     max_prompt_len: int,
+    start: int = 0,
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
@@ -224,7 +226,7 @@ def make_prefill(
     """Build the prefill program body: write a padded prompt batch into
     the pool and sample each request's FIRST generated token.
 
-    ``prefill(params, pool, prompts, lens, slot_ids, key) ->
+    ``prefill(params, pool, prompts, lens, starts, slot_ids, key) ->
     (pool, first_tokens, ok)`` — ``prompts [B, max_prompt_len]`` int32
     (pad beyond ``lens``), ``slot_ids [B]`` the target slots (``-1`` =
     padding row, which writes only to the trash page).  The prompt
@@ -233,12 +235,34 @@ def make_prefill(
     these shapes; a fused wide-prompt pass is a future optimization the
     compile-signature pin would catch drifting).  On exit the target
     slots are active with ``seq_len = lens`` — exactly the state the
-    next decode tick expects."""
+    next decode tick expects.
+
+    ``start`` is the prefix cache's STATIC start offset: the scan runs
+    positions ``[start, max_prompt_len)`` only — the skipped iterations
+    are the prefill FLOPs a radix hit saves, and the offset being a
+    compile-time constant keeps every position/RoPE angle absolute and
+    therefore bitwise-identical to the cold program's (one compiled
+    variant per distinct offset, cached; the engine quantizes offsets
+    to PAGE multiples — ``ServeEngine._scan_start`` — so the variant
+    universe is bounded and warmup covers it all).  ``starts [B]``
+    carries each row's own matched length (``>= start``): rows never
+    write positions below their own ``starts`` — those positions'
+    KV already sit in the pages ``kv_pages.adopt_prefix`` seated in the
+    row's table, and the attention gather reads them like any other
+    page.  A row whose ``starts`` exceeds ``start`` replays the gap's
+    compute bit-exactly (same tokens, same positions) with its writes
+    trash-routed, so correctness never depends on the grouping — it is
+    how a partial-page match rides a page-aligned variant."""
     if cfg.n_experts > 0:
         raise NotImplementedError("serve/ decodes dense-FFN configs only")
+    if not 0 <= start < max_prompt_len:
+        raise ValueError(
+            f"start={start} must sit in [0, max_prompt_len="
+            f"{max_prompt_len})"
+        )
     s_on, s_policy = sentinels.resolve(sentinel)
 
-    def prefill(params, pool, prompts, lens, slot_ids, key):
+    def prefill(params, pool, prompts, lens, starts, slot_ids, key):
         B = prompts.shape[0]
         n_pages = pool["free"].shape[0]
         page_len = pool["k"].shape[2]
@@ -249,7 +273,7 @@ def make_prefill(
             pool, last_logits, ok_all = carry
             tok = prompts[:, i]
             pos = jnp.full((B,), i, jnp.int32)
-            writing = valid_row & (i < lens)
+            writing = valid_row & (i >= starts) & (i < lens)
             need = writing & (i % page_len == 0)
             pool, ok = kv_pages.reserve_pages(pool, slot_ids, pos, need)
             pages, offs = kv_pages.write_page_ids(
@@ -291,7 +315,7 @@ def make_prefill(
             body,
             (pool, jnp.zeros((B, cfg.vocab_size), jnp.float32),
              jnp.bool_(True)),
-            jnp.arange(max_prompt_len),
+            jnp.arange(start, max_prompt_len),
         )
         if temperature == 0.0:
             first = last_logits.argmax(-1).astype(jnp.int32)
@@ -324,6 +348,15 @@ def _release(pool, mask):
     return kv_pages.release_slots(pool, mask)
 
 
+# prefix-cache device ops: shapes respecialize per pool geometry under
+# jit, so one wrapper each serves every engine.  Neither donates its
+# pool — they run once per admission/eviction burst, the cheap side of
+# the same trade the release program documents below.
+_adopt = jax.jit(kv_pages.adopt_prefix)
+_unref = jax.jit(kv_pages.unref_pages)
+_ref = jax.jit(kv_pages.ref_pages)
+
+
 # One compiled (tick, prefill, release) triple per build key: the ramp
 # engine and both A/B engines of a `bench.py --serve` run (and every
 # same-config test engine) reuse XLA programs instead of paying the
@@ -346,10 +379,6 @@ def _compiled_programs(
         tick = make_decode_tick(
             cfg, temperature=temperature, sentinel=sentinel
         )
-        pre = make_prefill(
-            cfg, max_prompt_len=max_prompt_len, temperature=temperature,
-            sentinel=sentinel,
-        )
         # tick/prefill donate their POOL argument (position 1).  release
         # deliberately does NOT donate: aliasing the pool through the
         # release program was measured to slow every SUBSEQUENT
@@ -361,10 +390,39 @@ def _compiled_programs(
         pool_kw = {"donate_argnums": (1,)} if donate else {}
         _PROGRAM_CACHE[key] = (
             jax.jit(tick, **pool_kw),
-            jax.jit(pre, **pool_kw),
+            _prefill_variant(
+                cfg, max_prompt_len=max_prompt_len, start=0,
+                temperature=temperature, sentinel=sentinel, donate=donate,
+            ),
             jax.jit(_release),
         )
     return _PROGRAM_CACHE[key]
+
+
+# prefix-cached prefill variants: one compiled program per STATIC start
+# offset (the skipped scan iterations are the saved FLOPs; a dynamic
+# offset would leave the scan length — and the bill — unchanged).
+# Cached separately from the tick/release pair so a new offset never
+# recompiles those.
+_PREFILL_CACHE: dict[tuple, Any] = {}
+
+
+def _prefill_variant(
+    cfg: LlamaConfig, *, max_prompt_len: int, start: int,
+    temperature: float, sentinel: bool | None, donate: bool,
+):
+    key = (
+        cfg, max_prompt_len, start, temperature,
+        sentinels.resolve(sentinel), donate,
+    )
+    if key not in _PREFILL_CACHE:
+        pre = make_prefill(
+            cfg, max_prompt_len=max_prompt_len, start=start,
+            temperature=temperature, sentinel=sentinel,
+        )
+        pool_kw = {"donate_argnums": (1,)} if donate else {}
+        _PREFILL_CACHE[key] = jax.jit(pre, **pool_kw)
+    return _PREFILL_CACHE[key]
 
 
 # ----------------------------------------------------------- host engine
@@ -427,6 +485,7 @@ class ServeEngine:
         clock: str = "wall",
         tick_s: float = 1e-3,
         seed: int = 0,
+        prefix_cache: bool = False,
     ):
         if admission not in ("continuous", "static"):
             raise ValueError(
@@ -458,6 +517,10 @@ class ServeEngine:
         self.clock = clock
         self.tick_s = tick_s
         self._key = jax.random.PRNGKey(seed)
+        # kept for the lazily-compiled start-offset prefill variants
+        self._temperature = temperature
+        self._sentinel = sentinel
+        self._donate = donate
 
         self.pool = kv_pages.init_page_pool(
             cfg, n_pages=n_pages, page_len=page_len, max_slots=max_slots,
@@ -466,6 +529,17 @@ class ServeEngine:
         self._tick, self._prefill, self._release = _compiled_programs(
             cfg, max_prompt_len=max_prompt_len, temperature=temperature,
             sentinel=sentinel, donate=donate,
+        )
+        # radix prefix cache (opt-in): host index over cached prompt
+        # pages; device sharing runs through kv_pages.adopt_prefix /
+        # ref_pages / unref_pages and the per-offset prefill variants
+        self.prefix: PrefixCache | None = (
+            PrefixCache(page_len) if prefix_cache else None
+        )
+        # analytic forward cost of one prompt token (the standard
+        # 2·N_params estimate) — prices prefill_flops_saved
+        self._flops_per_token = 2 * sum(
+            int(np.prod(x.shape)) for x in jax.tree.leaves(params)
         )
 
         # host state
@@ -477,6 +551,13 @@ class ServeEngine:
         # pages a completed slot still holds on device until the next
         # release flush — part of the exact free-mask mirror
         self._pending_pages: list[int] = [0] * max_slots
+        # prefix-cache mirrors: pages each live slot shares by
+        # reference (adopted full prefix pages) and pages the cache
+        # claimed OUT of the slot's own prompt at insert — both pin
+        # their pages against eviction while the slot lives, and both
+        # re-bucket the exact device-used mirror
+        self._adopted_pages: list[list[int]] = [[] for _ in range(max_slots)]
+        self._cached_pages: list[list[int]] = [[] for _ in range(max_slots)]
         self._t0 = time.perf_counter()
         self._vtime = 0.0
         self._ticks = 0
@@ -489,6 +570,10 @@ class ServeEngine:
         self.generated_tokens = 0
         self.pool_ok_failures = 0
         self.peak_pages = 0
+        # prefill work a radix hit skipped (tokens of admitted prompts
+        # not run through the model; FLOPs priced at 2·N_params/token)
+        self.prefill_tokens_saved = 0
+        self.prefill_flops_saved = 0
         self.queue_depths: list[int] = []
         self.ttft_s: list[float] = []
         self.tick_wall_s: list[float] = []
@@ -546,14 +631,83 @@ class ServeEngine:
         self._reserved = [0] * self.max_slots
         self._release_mask = [False] * self.max_slots
         self._pending_pages = [0] * self.max_slots
+        self._adopted_pages = [[] for _ in range(self.max_slots)]
+        self._cached_pages = [[] for _ in range(self.max_slots)]
+        if self.prefix is not None:  # drop the probe's cached prompt
+            self.prefix = PrefixCache(self.page_len)
+            # compile the sharing ops at the exact shapes the engine
+            # calls them with (all-padding args: no state mutates) —
+            # otherwise the FIRST radix hit pays the _adopt compile as
+            # TTFT (observed: one 300 ms outlier in an all-4 ms run)
+            self.pool = _ref(self.pool, jnp.full(
+                (self.pages_per_seq * self.prefill_batch,), -1, jnp.int32
+            ))
+            self.pool = _unref(self.pool, jnp.full(
+                (self.n_pages,), -1, jnp.int32
+            ))
+            self.pool, _ok = _adopt(
+                self.pool,
+                jnp.full((self.prefill_batch,), -1, jnp.int32),
+                jnp.full(
+                    (self.prefill_batch, self.pages_per_seq), -1,
+                    jnp.int32,
+                ),
+                jnp.full((self.prefill_batch,), -1, jnp.int32),
+            )
+            # every start-offset variant a radix hit can ride: scan
+            # starts are quantized to page multiples (_scan_start), so
+            # this is the WHOLE universe — nothing compiles mid-run
+            self.warm_prefill_starts(
+                range(self.page_len, self.max_prompt_len, self.page_len)
+            )
         self._vtime = 0.0
         self._ticks = self._prefills = 0
         self.admitted = self.completed = self.generated_tokens = 0
         self.rejected = {}
         self.pool_ok_failures = 0
         self.peak_pages = 0
+        self.prefill_tokens_saved = self.prefill_flops_saved = 0
         self.queue_depths, self.ttft_s, self.tick_wall_s = [], [], []
         self.done, self.token_log = [], []
+        self._t0 = time.perf_counter()
+
+    def warm_prefill_starts(self, starts) -> None:
+        """Compile start-offset prefill variants OFF the clock — the
+        same contract as :meth:`warmup`, for the programs a radix hit
+        will reach for.  Without this the FIRST cache hit at each new
+        offset pays XLA compile on the wall clock (observed: ramp TTFT
+        p95 3.9 ms -> 1.2 s on the smoke when the shared-prefix trace's
+        first hit compiled mid-run).  Each variant runs one all-padding
+        batch against a scratch pool: every write trash-routes, no
+        engine state is touched.  warmup() calls this with every page
+        multiple below ``max_prompt_len`` — the whole universe, since
+        ``_scan_start`` quantizes live offsets to page multiples."""
+        for start in sorted({int(s) for s in starts}):
+            if not 0 < start < self.max_prompt_len:
+                continue  # 0 is the base program warmup() already ran
+            fn = _prefill_variant(
+                self.cfg, max_prompt_len=self.max_prompt_len,
+                start=start, temperature=self._temperature,
+                sentinel=self._sentinel, donate=self._donate,
+            )
+            scratch = kv_pages.init_page_pool(
+                self.cfg, n_pages=self.n_pages, page_len=self.page_len,
+                max_slots=self.max_slots,
+                pages_per_seq=self.pages_per_seq,
+            )
+            B = self.prefill_batch
+            fn(
+                self.params, scratch,
+                jnp.zeros((B, self.max_prompt_len), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.full((B,), start, jnp.int32),
+                jnp.full((B,), -1, jnp.int32),
+                jax.random.PRNGKey(0),
+            )
+        # re-zero the wall clock like warmup() does: the compiles above
+        # ran AFTER warmup reset _t0, and an open-loop run() against a
+        # stale origin sees every early arrival as already overdue —
+        # their TTFT would bill the warm time the method exists to hide
         self._t0 = time.perf_counter()
 
     def _advance(self, dt: float) -> None:
@@ -588,8 +742,15 @@ class ServeEngine:
             # logits buffer (a token the model never produced); reject
             # at the door rather than serve garbage
             reason = REJECT_BAD_REQUEST
-        elif (req.prompt_len > self.max_prompt_len
-                or total > self.max_seq_len):
+        elif req.prompt_len > self.max_prompt_len:
+            # over the prefill program's STATIC prompt capacity: no
+            # compiled program of this engine can ever run it, so it is
+            # a malformed request for this build — bad_request, not the
+            # policy-capacity too_long it used to be conflated with
+            # (too_long means "well-formed but over the context budget";
+            # lumping shape-impossible prompts in skewed that counter)
+            reason = REJECT_BAD_REQUEST
+        elif total > self.max_seq_len:
             reason = REJECT_TOO_LONG
         elif self._pages_needed(req) > self.n_pages:
             reason = REJECT_POOL_EXHAUSTED
@@ -609,26 +770,97 @@ class ServeEngine:
     def _free_slots(self) -> list[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
 
-    def _admittable(self) -> list[tuple[int, Request]]:
-        """(slot, request) pairs the scheduler can admit right now:
-        bounded by free slots, the prefill batch width, and the pool's
-        unreserved pages (worst-case accounting)."""
+    def _committed_pages(self) -> int:
+        """Worst-case pages spoken for: live-slot reservations (fresh
+        pages only — adopted prefix pages are billed once, under the
+        cache) plus every page the prefix cache holds."""
+        held = self.prefix.held_pages if self.prefix is not None else 0
+        return self._reserved_total() + held
+
+    def _pinned_pages(self) -> set[int]:
+        """Cached pages eviction must not touch: shared into a live
+        slot's table (adopted) or claimed out of one (own prompt pages
+        the cache indexed).  Flush clears both lists, so a completed
+        slot stops pinning exactly when the device release runs."""
+        pinned: set[int] = set()
+        for pages in self._adopted_pages:
+            pinned.update(pages)
+        for pages in self._cached_pages:
+            pinned.update(pages)
+        return pinned
+
+    def _evict_for(self, shortfall: int, protect: set[int]) -> int:
+        """LRU-evict cached pages to free ``shortfall`` pool pages.
+        Returns how many were actually freed (0 when the evictable set
+        is too small — the caller backpressures like any other
+        page-short admission)."""
+        assert self.prefix is not None
+        pinned = self._pinned_pages() | protect
+        if self.prefix.evictable_pages(pinned) < shortfall:
+            return 0
+        evicted = self.prefix.evict(shortfall, pinned)
+        if evicted:
+            pages = np.full((self.n_pages,), -1, np.int32)
+            pages[: len(evicted)] = evicted
+            self.pool = _unref(self.pool, jnp.asarray(pages))
+        return len(evicted)
+
+    def _match(self, req: Request) -> Match:
+        if self.prefix is None:
+            return Match()
+        return self.prefix.match(req.prompt)
+
+    def _scan_start(self, m: Match) -> int:
+        """The compiled-variant offset a match rides: the page-aligned
+        floor of its matched length.  Quantizing here bounds the
+        variant universe to page multiples (all warmed off the clock)
+        at the cost of replaying at most ``page_len - 1`` matched
+        positions per request — their writes stay masked by the
+        per-row ``starts``, so the replay is bit-exact by construction."""
+        return (m.matched // self.page_len) * self.page_len
+
+    def _admittable(self) -> list[tuple[int, Request, Match]]:
+        """(slot, request, prefix-match) triples the scheduler can
+        admit right now: bounded by free slots, the prefill batch
+        width, and the pool's uncommitted pages (worst-case accounting
+        counts only the SUFFIX pages of a matched request — the
+        adopted prefix is already resident).  Batches are homogeneous
+        in their PAGE-ALIGNED matched floor (``_scan_start``) so the
+        whole batch rides one static start-offset prefill variant;
+        when the free set is short, LRU eviction of unpinned cached
+        pages runs before backpressure."""
         if self.admission == "static" and any(
             r is not None for r in self.slots
         ):
             return []  # static batching: wait for the batch to drain
         free = self._free_slots()
-        budget = self.n_pages - self._reserved_total()
-        out: list[tuple[int, Request]] = []
+        budget = self.n_pages - self._committed_pages()
+        out: list[tuple[int, Request, Match]] = []
+        protect: set[int] = set()
         while (self.queue and free
                and len(out) < self.prefill_batch):
-            need = self._pages_needed(self.queue[0])
+            m = self._match(self.queue[0])
+            if out and self._scan_start(m) != self._scan_start(out[0][2]):
+                break  # next batch: different static start offset
+            need = self._pages_needed(self.queue[0]) - m.n_ref
             if need > budget:
-                break  # head-of-line blocks until pages free (backpressure)
+                if self.prefix is None:
+                    break  # head-of-line blocks until pages free
+                got = self._evict_for(
+                    need - budget,
+                    protect | set(m.pages)
+                    | ({m.cow_src} if m.cow_src >= 0 else set()),
+                )
+                if got < need - budget:
+                    break  # backpressure: nothing evictable enough
+                budget += got
             req = self.queue.popleft()
             slot = free.pop(0)
             budget -= need
-            out.append((slot, req))
+            protect.update(m.pages)
+            if m.cow_src >= 0:
+                protect.add(m.cow_src)
+            out.append((slot, req, m))
         return out
 
     # ---- the scheduler iteration --------------------------------------
@@ -637,41 +869,130 @@ class ServeEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _run_prefill(self, batch: list[tuple[int, Request]]) -> None:
+    def _adopt_batch(self, batch: list[tuple[int, Request, Match]]) -> None:
+        """Seat every matched prefix before the suffix prefill: full
+        pages by reference, the partial tail page as a COW copy
+        (``kv_pages.adopt_prefix``)."""
+        if not any(m.matched for _, _, m in batch):
+            return
+        B = self.prefill_batch
+        slots = np.full((B,), -1, np.int32)
+        adopt = np.full((B, self.pages_per_seq), -1, np.int32)
+        cow = np.full((B,), -1, np.int32)
+        for row, (slot, _req, m) in enumerate(batch):
+            slots[row] = slot
+            adopt[row, : m.n_ref] = m.pages
+            cow[row] = m.cow_src
+        self.pool, ok = _adopt(
+            self.pool, jnp.asarray(slots), jnp.asarray(adopt),
+            jnp.asarray(cow),
+        )
+        if not bool(ok):
+            self.pool_ok_failures += 1
+
+    def _insert_prefixes(
+        self, batch: list[tuple[int, Request, Match]]
+    ) -> None:
+        """Index the just-prefilled prompts in the radix tree and take
+        the cache's device references on every NEWLY claimed page.
+        Pages the cache claims move from the slot's bill to the
+        cache's (``_committed_pages`` stays exact); a slot that
+        completed during this very prefill re-buckets its pending
+        mirror instead."""
+        assert self.prefix is not None
+        table = np.asarray(jax.device_get(self.pool["page_table"]))
+        claimed: list[int] = []
+        for _row, (slot, req, _m) in enumerate(batch):
+            new_pages = self.prefix.insert(req.prompt, table[slot])
+            claimed.extend(new_pages)
+            self._cached_pages[slot] = new_pages
+            n_new = len(new_pages)
+            if self.slots[slot] is None:  # completed at its first token
+                self._pending_pages[slot] = max(
+                    0, self._pending_pages[slot] - n_new
+                )
+            else:
+                self._reserved[slot] = max(0, self._reserved[slot] - n_new)
+        if claimed:
+            width = self.pages_per_seq * self.prefill_batch
+            pages = np.full((width,), -1, np.int32)
+            pages[: len(claimed)] = claimed
+            self.pool = _ref(self.pool, jnp.asarray(pages))
+
+    def _run_prefill(self, batch: list[tuple[int, Request, Match]]) -> None:
         from ddl25spring_tpu.obs import flight
 
         B = self.prefill_batch
+        # the scan starts at the PAGE-ALIGNED floor of the batch's
+        # matched length (batches are floor-homogeneous): rows replay
+        # the [start, matched) gap bit-exactly with writes masked, so
+        # only page-multiple offsets ever exist as compiled variants —
+        # all of them warmed by warmup(), none compiled mid-run (an
+        # accidental partial-prefix hit on random traffic would
+        # otherwise compile an arbitrary-offset program on the clock)
+        start = self._scan_start(batch[0][2])
         prompts = np.zeros((B, self.max_prompt_len), np.int32)
         lens = np.zeros((B,), np.int32)
+        starts = np.zeros((B,), np.int32)
         slot_ids = np.full((B,), -1, np.int32)
-        for row, (slot, req) in enumerate(batch):
+        for row, (slot, req, m) in enumerate(batch):
             prompts[row, : req.prompt_len] = req.prompt
             lens[row] = req.prompt_len
+            starts[row] = m.matched
             slot_ids[row] = slot
+        self._adopt_batch(batch)
+        prefill = self._prefill if start == 0 else _prefill_variant(
+            self.cfg, max_prompt_len=self.max_prompt_len, start=start,
+            temperature=self._temperature, sentinel=self._sentinel,
+            donate=self._donate,
+        )
         t0 = time.perf_counter()
-        self.pool, first, ok = self._prefill(
+        self.pool, first, ok = prefill(
             self.params, self.pool, jnp.asarray(prompts),
-            jnp.asarray(lens), jnp.asarray(slot_ids), self._split_key(),
+            jnp.asarray(lens), jnp.asarray(starts), jnp.asarray(slot_ids),
+            self._split_key(),
         )
         first = jax.device_get(first)
         if not bool(ok):
             self.pool_ok_failures += 1
         wall = time.perf_counter() - t0
         self._prefills += 1
-        self._advance(self.tick_s)
+        # the virtual clock charges prefill for the scan it actually
+        # ran: a start-offset variant costs proportionally less — the
+        # deterministic half of the cached-vs-cold A/B (the wall clock
+        # measures the same saving, noisily)
+        self._advance(
+            self.tick_s * (self.max_prompt_len - start)
+            / self.max_prompt_len
+        )
         now = self.now()
-        for row, (slot, req) in enumerate(batch):
+        for row, (slot, req, m) in enumerate(batch):
             req.admitted_t = now
             self.slots[slot] = req
-            self._reserved[slot] = self._pages_needed(req)
+            self._adopted_pages[slot] = list(m.pages)
+            self._cached_pages[slot] = []
+            self._reserved[slot] = self._pages_needed(req) - m.n_ref
             self.admitted += 1
+            if self.prefix is not None:
+                self.prefix.lookups += 1
+                if m.matched > 0:
+                    self.prefix.hits += 1
+                    self.prefix.hit_tokens += m.matched
+            # saved = the scan positions actually skipped (the aligned
+            # floor), not the matched length — the [start, matched) gap
+            # is replayed, so billing it as saved would overcount
+            self.prefill_tokens_saved += start
+            self.prefill_flops_saved += start * self._flops_per_token
             self._emit_token(slot, req, int(first[row]), now)
             req.first_token_t = now
             self.ttft_s.append(now - req.arrival_t)
+        if self.prefix is not None:
+            self._insert_prefixes(batch)
         self._track_pages()
         flight.record(
             kind="serve_prefill", step=self._prefills, wall_s=round(wall, 6),
             admitted=len(batch), queue=len(self.queue),
+            **({"prefix_start": start} if start else {}),
         )
 
     def _emit_token(self, slot: int, req: Request, tok: int,
@@ -689,11 +1010,12 @@ class ServeEngine:
             self._release_mask[slot] = True
             # the device keeps this sequence's pages until the release
             # flush; mirror them so peak accounting can't miss a
-            # request that completed the same iteration it prefilled
+            # request that completed the same iteration it prefilled.
+            # Only the slot's EXCLUSIVE pages count here — adopted and
+            # cache-claimed pages are billed once, under the cache.
             written = req.prompt_len + len(req.tokens) - 1
-            self._pending_pages[slot] = min(
-                -(-written // self.page_len) if written else 0,
-                self.pages_per_seq,
+            self._pending_pages[slot] = self._slot_fresh_pages(
+                slot, written
             )
 
     def _run_decode_tick(self) -> None:
@@ -727,23 +1049,35 @@ class ServeEngine:
                 pages_used=self._host_pages_used(),
             )
 
+    def _slot_fresh_pages(self, slot: int, written: int) -> int:
+        """Pages slot ``slot`` holds EXCLUSIVELY after writing
+        ``written`` positions: its table entries so far, minus the
+        prefix pages it shares by reference and the own-prompt pages
+        the cache claimed (both billed under the cache)."""
+        entries = min(
+            -(-written // self.page_len) if written > 0 else 0,
+            self.pages_per_seq,
+        )
+        shared = len(self._adopted_pages[slot]) + len(
+            self._cached_pages[slot]
+        )
+        return max(entries - shared, 0)
+
     def _host_pages_used(self) -> int:
         """Exact host mirror of the device free mask: pages a slot has
-        actually allocated so far (grows lazily page by page).  The
-        newest sampled token is NOT yet written — its KV lands during
-        the next decode tick — so an active slot's written positions
-        are ``prompt + generated - 1``; completed slots keep their
-        pages until the release flush (``_pending_pages``)."""
-        used = 0
+        actually allocated so far (grows lazily page by page) plus
+        every page the prefix cache references.  The newest sampled
+        token is NOT yet written — its KV lands during the next decode
+        tick — so an active slot's written positions are
+        ``prompt + generated - 1``; completed slots keep their pages
+        until the release flush (``_pending_pages``)."""
+        used = self.prefix.held_pages if self.prefix is not None else 0
         for slot, req in enumerate(self.slots):
             if req is None:
                 used += self._pending_pages[slot]
                 continue
             written = req.prompt_len + max(len(req.tokens) - 1, 0)
-            used += min(
-                -(-written // self.page_len) if written else 0,
-                self.pages_per_seq,
-            )
+            used += self._slot_fresh_pages(slot, written)
         return used
 
     def _track_pages(self) -> None:
@@ -755,6 +1089,10 @@ class ServeEngine:
         self.pool = self._release(
             self.pool, jnp.asarray(np.asarray(self._release_mask))
         )
+        for slot, flushed in enumerate(self._release_mask):
+            if flushed:  # the slot stops pinning its shared pages
+                self._adopted_pages[slot] = []
+                self._cached_pages[slot] = []
         self._release_mask = [False] * self.max_slots
         self._pending_pages = [0] * self.max_slots
 
@@ -890,6 +1228,18 @@ class ServeEngine:
                 self.peak_pages / self.n_pages, 4
             ),
             "pool_ok_failures": self.pool_ok_failures,
+            # radix prefix cache: the deterministic counters the
+            # cached-vs-cold A/B and the serve_report gates read
+            "prefix_hit_rate": (
+                self.prefix.stats()["hit_rate"]
+                if self.prefix is not None else None
+            ),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefill_flops_saved": self.prefill_flops_saved,
+            "prefix": (
+                self.prefix.stats() if self.prefix is not None
+                else {"enabled": False}
+            ),
             "config": {
                 "page_len": self.page_len,
                 "pages_per_seq": self.pages_per_seq,
@@ -899,6 +1249,7 @@ class ServeEngine:
                 "max_queue": self.max_queue,
                 "token_budget": self.token_budget,
                 "clock": self.clock,
+                "prefix_cache": self.prefix is not None,
             },
         }
 
@@ -915,6 +1266,7 @@ def make_tp_serve_program(
     pages_per_seq: int = 4,
     max_slots: int = 4,
     max_prompt_len: int = 8,
+    start: int = 0,
     model_axis: str = "model",
     temperature: float = 0.0,
     sentinel: bool | None = False,
@@ -963,10 +1315,10 @@ def make_tp_serve_program(
         in_specs = (p_specs, pool_specs, P(), P())
     else:
         body = make_prefill(
-            cfg, max_prompt_len=max_prompt_len, temperature=temperature,
-            tp_axis=tp_axis, sentinel=sentinel,
+            cfg, max_prompt_len=max_prompt_len, start=start,
+            temperature=temperature, tp_axis=tp_axis, sentinel=sentinel,
         )
-        in_specs = (p_specs, pool_specs, P(), P(), P(), P())
+        in_specs = (p_specs, pool_specs, P(), P(), P(), P(), P())
 
     def wrapped(params, pool, *rest):
         if tp_axis is not None:
@@ -987,11 +1339,16 @@ def make_tp_serve_program(
     return fn, pool, pool_specs
 
 
-def describe(mesh, program: str = "decode", model_axis: str = "model"):
+def describe(mesh, program: str = "decode", model_axis: str = "model",
+             start: int = 0):
     """Compile-analytics/graft-lint hook for the serving programs
     (:data:`ddl25spring_tpu.obs.xla_analytics.STRATEGIES` entries
-    ``serve-decode`` / ``serve-prefill``): the TP-sharded decode tick /
-    prefill lowered exactly as the engine builds them.
+    ``serve-decode`` / ``serve-prefill`` / ``serve-prefill-cached``):
+    the TP-sharded decode tick / prefill lowered exactly as the engine
+    builds them.  ``start > 0`` pins the prefix cache's start-offset
+    prefill variant — the scan shortens to ``max_prompt_len - start``
+    positions, so its collective count (and the FLOPs the radix hit
+    saves) is a compile-time fact the signature gate can hold.
 
     The load-bearing signature: TP serving traffic is the row-parallel
     **all-reduce ONLY** — 2 psums per block per token position, every
@@ -1018,8 +1375,8 @@ def describe(mesh, program: str = "decode", model_axis: str = "model"):
     fn, pool, _specs = make_tp_serve_program(
         cfg, mesh, program, page_len=page_len,
         pages_per_seq=pages_per_seq, max_slots=max_slots,
-        max_prompt_len=max_prompt_len, model_axis=model_axis,
-        sentinel=False,
+        max_prompt_len=max_prompt_len, start=start,
+        model_axis=model_axis, sentinel=False,
     )
     if program == "decode":
         args = (
@@ -1035,11 +1392,13 @@ def describe(mesh, program: str = "decode", model_axis: str = "model"):
             params, pool,
             jnp.ones((prefill_batch, max_prompt_len), jnp.int32),
             jnp.full((prefill_batch,), max_prompt_len, jnp.int32),
+            jnp.full((prefill_batch,), start, jnp.int32),
             jnp.arange(prefill_batch, dtype=jnp.int32),
             jax.random.PRNGKey(1),
         )
-        # every prompt position runs the block stack
-        ar_count = 2 * cfg.n_layers * max_prompt_len
+        # every SCANNED prompt position runs the block stack — the
+        # start-offset variant's shorter count IS the saved prefill
+        ar_count = 2 * cfg.n_layers * (max_prompt_len - start)
         lowered = "prefill_step"
 
     expected: dict[str, Any] = {
@@ -1073,7 +1432,8 @@ def describe(mesh, program: str = "decode", model_axis: str = "model"):
             "n_pages": max_slots * pages_per_seq,
             "tp": t,
             **({"max_prompt_len": max_prompt_len,
-                "prefill_batch": prefill_batch}
+                "prefill_batch": prefill_batch,
+                "start": start}
                if program == "prefill" else {}),
         },
         "expected": expected,

@@ -29,6 +29,30 @@ are gathered in table order, so position ``p`` lands at row ``p`` of the
 view; dead entries are masked with the identical ``-1e30`` fill before
 softmax.  In fp32 the paged decode therefore reproduces the dense
 decode *bitwise*, token for token.
+
+**Reference counting (PR 11)** makes pages *shareable*: ``refcount
+[n_pages] int32`` joins the pool, ``free`` is exactly ``refcount == 0``
+at all times, allocation sets a page's count to 1, and
+:func:`release_slots` DECREMENTS instead of freeing — a page returns to
+the free set only when its last reference drops.  Sharing enters
+through two new jit-safe ops the radix prefix cache
+(:mod:`ddl25spring_tpu.serve.prefix`) drives:
+
+- :func:`adopt_prefix` — enter already-resident pages into a new
+  sequence's page table by reference (``refcount += 1``; full pages of
+  a cached prompt prefix are immutable after prefill, so sharing them
+  is read-only), and copy-on-write duplicate the ONE partially-filled
+  page a matched prefix may end in: the adopter gets a fresh first-fit
+  page holding a bit-for-bit copy, so its suffix appends never touch
+  the shared original.
+- :func:`ref_pages` / :func:`unref_pages` — the prefix cache's own
+  references (a cached page survives its owning sequence's completion;
+  LRU eviction is an unref, and frees the page only at refcount 0).
+
+The pool invariant under ANY allocate/adopt/COW/release/unref
+interleaving — ``used + free == n_pages``, ``free == (refcount == 0)``,
+no double-free, no leak, the COW copy reachable from exactly one page
+table — is pinned by the seeded sweep in ``tests/test_serve_prefix.py``.
 """
 
 from __future__ import annotations
@@ -49,6 +73,7 @@ __all__ = [
     "resolve_heads", "init_page_pool", "pool_geometry", "reserve_pages",
     "write_page_ids", "append_layer_kv",
     "release_slots", "activate_slots", "used_pages",
+    "adopt_prefix", "ref_pages", "unref_pages",
 ]
 
 
@@ -79,7 +104,11 @@ def init_page_pool(
         "page_table": jnp.full((max_slots, pages_per_seq), -1, jnp.int32),
         "seq_len": jnp.zeros((max_slots,), jnp.int32),
         "active": jnp.zeros((max_slots,), bool),
+        # free is kept exactly == (refcount == 0) by every mutator; the
+        # redundancy buys the allocation argsort a bool mask and keeps
+        # the PR-10 pool contract (`~pool["free"]` = used) intact
         "free": jnp.ones((n_pages,), bool),
+        "refcount": jnp.zeros((n_pages,), jnp.int32),
     }
 
 
@@ -136,12 +165,20 @@ def reserve_pages(pool: Pool, slots: jax.Array, pos: jax.Array,
     pages = order[jnp.clip(rank, 0, n_pages - 1)]
     take = need & ok
 
-    free = free.at[jnp.where(take, pages, n_pages)].set(False, mode="drop")
+    # a freshly-allocated page starts at refcount 1 (sole owner: the
+    # allocating sequence); pages leave the free set exactly when their
+    # count leaves zero
+    refcount = pool["refcount"].at[
+        jnp.where(take, pages, n_pages)
+    ].add(1, mode="drop")
     table = pool["page_table"].at[
         jnp.where(take, slots, pool["page_table"].shape[0]),
         jnp.clip(entry, 0, P - 1),
     ].set(pages, mode="drop")
-    return {**pool, "free": free, "page_table": table}, ok
+    return {
+        **pool, "free": refcount == 0, "refcount": refcount,
+        "page_table": table,
+    }, ok
 
 
 def write_page_ids(pool: Pool, slots: jax.Array, pos: jax.Array,
@@ -171,23 +208,122 @@ def append_layer_kv(k_pages, v_pages, layer, pages, offs, k, v):
 
 
 def release_slots(pool: Pool, slot_mask: jax.Array) -> Pool:
-    """Free every page of the masked slots and reset their tables —
-    finished sequences return their capacity to the pool (the operation
-    the dense ``[B, max_len]`` slab cannot express)."""
+    """Drop every masked slot's references and reset its table.  With
+    refcounts this is a DECREMENT, not a free: a page returns to the
+    free set only when its count reaches 0 — pages shared with the
+    prefix cache (or with another still-live sequence) survive the
+    owner's completion.  Two released slots sharing a page decrement it
+    twice (scatter-add accumulates duplicates)."""
     n_pages = pool["free"].shape[0]
     rows = pool["page_table"]
     freed = slot_mask[:, None].astype(bool) & (rows >= 0)
-    free = pool["free"].at[
+    refcount = pool["refcount"].at[
         jnp.where(freed, jnp.clip(rows, 0, n_pages - 1), n_pages)
-    ].set(True, mode="drop")
+    ].add(-1, mode="drop")
+    refcount = jnp.maximum(refcount, 0)
     table = jnp.where(slot_mask[:, None], jnp.int32(-1), rows)
     return {
         **pool,
-        "free": free,
+        "free": refcount == 0,
+        "refcount": refcount,
         "page_table": table,
         "seq_len": jnp.where(slot_mask, 0, pool["seq_len"]),
         "active": pool["active"] & ~slot_mask.astype(bool),
     }
+
+
+def adopt_prefix(pool: Pool, slots: jax.Array, adopt_pages: jax.Array,
+                 cow_src: jax.Array):
+    """Enter a matched prefix into newly-admitted sequences' page
+    tables (the radix cache's sharing op, run by the engine BEFORE the
+    suffix prefill).  Per batch row ``b``:
+
+    - ``adopt_pages[b, e] >= 0`` — share that resident page by
+      reference at table entry ``e`` (``refcount += 1``; full prompt
+      pages are immutable after their prefill, so by-reference sharing
+      is read-only by construction),
+    - ``cow_src[b] >= 0`` — the matched prefix ends inside this
+      partially-filled page: allocate a fresh first-fit page, copy the
+      source page's k/v rows bit for bit, and seat the COPY at the
+      row's next table entry (= its count of adopted entries).  The
+      adopter's suffix appends land in the copy; the shared original is
+      never written.  Two rows COWing the same source each get their
+      own copy.
+
+    ``slots[b] < 0`` marks a padding row.  Returns ``(pool, ok)`` —
+    all-or-nothing like :func:`reserve_pages`: when the COW pages don't
+    fit the free set, NOTHING is adopted and ``ok`` is False (the
+    engine's admission accounting should have prevented it)."""
+    n_pages = pool["free"].shape[0]
+    P = pool["page_table"].shape[1]
+    S = pool["page_table"].shape[0]
+
+    row_ok = slots >= 0
+    valid = (adopt_pages >= 0) & row_ok[:, None]
+    need = (cow_src >= 0) & row_ok
+    cow_entry = jnp.sum(valid, axis=1)  # first entry past the adopted run
+
+    free = pool["free"]
+    order = jnp.argsort(~free, stable=True)
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+    # all-or-nothing (reserve_pages discipline): a COW that cannot get
+    # a fresh page, or whose entry falls past the table, fails the
+    # whole call with nothing adopted
+    ok = (jnp.sum(need) <= jnp.sum(free)) & jnp.all(~need | (cow_entry < P))
+    fresh = order[jnp.clip(rank, 0, n_pages - 1)]
+    valid = valid & ok
+    take = need & ok
+
+    refcount = pool["refcount"].at[
+        jnp.where(valid, adopt_pages, n_pages)
+    ].add(1, mode="drop")
+    refcount = refcount.at[
+        jnp.where(take, fresh, n_pages)
+    ].add(1, mode="drop")
+
+    table = pool["page_table"].at[
+        jnp.where(valid, slots[:, None], S),
+        jnp.broadcast_to(jnp.arange(P)[None, :], adopt_pages.shape),
+    ].set(adopt_pages, mode="drop")
+    table = table.at[
+        jnp.where(take, slots, S),
+        jnp.clip(cow_entry, 0, P - 1),
+    ].set(fresh, mode="drop")
+
+    # bit-for-bit page copy; masked rows read/write the trash row
+    src = jnp.where(take, cow_src, n_pages)
+    dst = jnp.where(take, fresh, n_pages)
+    k = pool["k"].at[dst].set(pool["k"][src], mode="drop")
+    v = pool["v"].at[dst].set(pool["v"][src], mode="drop")
+
+    return {
+        **pool, "k": k, "v": v, "free": refcount == 0,
+        "refcount": refcount, "page_table": table,
+    }, ok
+
+
+def ref_pages(pool: Pool, pages: jax.Array) -> Pool:
+    """Add one reference to each listed resident page (``-1`` = pad) —
+    how the prefix cache claims the prompt pages it just indexed, so
+    they outlive their owning sequence."""
+    n_pages = pool["free"].shape[0]
+    refcount = pool["refcount"].at[
+        jnp.where(pages >= 0, pages, n_pages)
+    ].add(1, mode="drop")
+    return {**pool, "free": refcount == 0, "refcount": refcount}
+
+
+def unref_pages(pool: Pool, pages: jax.Array) -> Pool:
+    """Drop one reference from each listed page (``-1`` = pad) — LRU
+    eviction's device half.  A page still referenced by a live
+    sequence's table survives (eviction is then only a cache miss for
+    future matches, never corruption)."""
+    n_pages = pool["free"].shape[0]
+    refcount = pool["refcount"].at[
+        jnp.where(pages >= 0, pages, n_pages)
+    ].add(-1, mode="drop")
+    refcount = jnp.maximum(refcount, 0)
+    return {**pool, "free": refcount == 0, "refcount": refcount}
 
 
 def activate_slots(pool: Pool, slots: jax.Array, valid: jax.Array) -> Pool:

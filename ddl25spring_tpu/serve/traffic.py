@@ -14,11 +14,21 @@ compare different workloads.
 - **Ramp profiles**: ``flat`` (constant), ``ramp`` (linear 0.1x -> 1x —
   the warm-up shape the CI smoke drives), ``spike`` (1/3 at 0.3x, 1/3
   at 1x, 1/3 at 0.3x — the overload shape that exercises queue
-  backpressure and rejections).
+  backpressure and rejections), ``shared`` (flat rate; the prefix-cache
+  workload below).
 - **Length mixes**: a categorical over ``(prompt_len, max_new)`` pairs
   (chat-style short-in/long-out next to retrieval-style long-in/
   short-out), prompt token ids drawn uniformly from ``[1, vocab)``
   (0 is pad by convention).
+- **Shared-prefix profile** (``shared``, PR 11): ``shared_prefixes``
+  seeded "system prompts" of ``shared_prefix_len`` tokens are drawn
+  ONCE; every arrival picks one uniformly and appends its own
+  ``shared_suffix_len`` random tokens (``max_new`` still drawn from the
+  mix's categorical).  Prompt length is therefore UNIFORM —
+  page-granular radix matches land at one matched length, so the
+  engine's start-homogeneous prefill batches never fragment — and at
+  production-shaped traffic most arrivals repeat a recent prefix: the
+  workload the radix prefix cache's cached-vs-cold A/B is gated on.
 
 Everything is host-side numpy off one ``RandomState(seed)`` — no jax,
 no device."""
@@ -37,7 +47,7 @@ DEFAULT_MIX: tuple[tuple[int, int, float], ...] = (
     (6, 6, 0.2),
 )
 
-PROFILES = ("flat", "ramp", "spike")
+PROFILES = ("flat", "ramp", "spike", "shared")
 
 
 @dataclass(frozen=True)
@@ -50,10 +60,22 @@ class TrafficSpec:
     profile: str = "ramp"
     mix: tuple[tuple[int, int, float], ...] = field(default=DEFAULT_MIX)
     vocab_size: int = 64
+    # the shared-prefix profile's shape: K system prompts x Poisson
+    # arrivals; prompt = prefix (shared_prefix_len) + per-request suffix
+    # (shared_suffix_len).  6 + 2 = 8 fits the smoke engine's
+    # max_prompt_len as exactly two full pages at the smoke page_len of
+    # 4, so radix hits share the first page BY REFERENCE (matched = 4;
+    # the second page mixes prefix tail with the per-request suffix and
+    # never matches).  The copy-on-write path needs a prompt that ENDS
+    # inside a page — it is pinned directly in
+    # tests/test_serve_prefix.py rather than ridden through this trace.
+    shared_prefixes: int = 2
+    shared_prefix_len: int = 6
+    shared_suffix_len: int = 2
 
     def rate_at(self, t: float) -> float:
         """Instantaneous arrival rate lambda(t) of the profile."""
-        if self.profile == "flat":
+        if self.profile in ("flat", "shared"):
             return self.rate_rps
         frac = t / self.duration_s if self.duration_s > 0 else 0.0
         if self.profile == "ramp":
@@ -77,6 +99,24 @@ def synth_trace(spec: TrafficSpec) -> list[dict[str, Any]]:
     rng = np.random.RandomState(spec.seed)
     weights = np.asarray([w for _, _, w in spec.mix], np.float64)
     weights = weights / weights.sum()
+    shared = spec.profile == "shared"
+    prefixes: list[list[int]] = []
+    if shared:
+        if spec.shared_prefixes < 1 or spec.shared_prefix_len < 1:
+            raise ValueError(
+                f"shared profile needs shared_prefixes="
+                f"{spec.shared_prefixes} >= 1 and shared_prefix_len="
+                f"{spec.shared_prefix_len} >= 1"
+            )
+        # the K "system prompts", drawn once up front so the whole
+        # trace shares them (and so the draw order — prefixes first,
+        # then arrivals — is part of the seeded contract)
+        prefixes = [
+            [int(x) for x in rng.randint(
+                1, spec.vocab_size, size=spec.shared_prefix_len
+            )]
+            for _ in range(spec.shared_prefixes)
+        ]
     out: list[dict[str, Any]] = []
     peak = max(spec.rate_at(t) for t in np.linspace(
         0.0, spec.duration_s, 64
@@ -89,12 +129,23 @@ def synth_trace(spec: TrafficSpec) -> list[dict[str, Any]]:
             break
         if rng.uniform() > spec.rate_at(t) / peak:
             continue  # thinned: the profile is below peak here
-        p_len, max_new, _ = spec.mix[int(rng.choice(len(spec.mix),
+        if shared:
+            _, max_new, _ = spec.mix[int(rng.choice(len(spec.mix),
                                                     p=weights))]
-        prompt = rng.randint(1, spec.vocab_size, size=int(p_len))
+            prefix = prefixes[int(rng.randint(spec.shared_prefixes))]
+            suffix = rng.randint(
+                1, spec.vocab_size, size=spec.shared_suffix_len
+            )
+            prompt = prefix + [int(x) for x in suffix]
+        else:
+            p_len, max_new, _ = spec.mix[int(rng.choice(len(spec.mix),
+                                                        p=weights))]
+            prompt = [int(x) for x in rng.randint(
+                1, spec.vocab_size, size=int(p_len)
+            )]
         out.append({
             "t": round(t, 6),
-            "prompt": [int(x) for x in prompt],
+            "prompt": prompt,
             "max_new": int(max_new),
         })
     return out

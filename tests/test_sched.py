@@ -432,7 +432,8 @@ def test_every_registered_strategy_carries_a_sched_report():
     from ddl25spring_tpu.obs.compile_report import DEFAULT_STRATEGIES
 
     assert set(DEFAULT_STRATEGIES) == set(xa.STRATEGIES)
-    assert len(DEFAULT_STRATEGIES) == 16  # 14 training + 2 serving (PR 10)
+    # 14 training + 2 serving (PR 10) + the cached-prefill variant (PR 11)
+    assert len(DEFAULT_STRATEGIES) == 17
     for name in DEFAULT_STRATEGIES:
         r = cached_strategy_report(name)
         s = r.get("sched")

@@ -239,9 +239,13 @@ def test_token_timeline_readout(params):
 
 def test_rejection_reasons(params):
     eng = make_engine(params, max_queue=1, token_budget=16)
-    # too long: prompt over max_prompt_len
+    # over the prefill program's STATIC prompt capacity: malformed for
+    # this build (no compiled program can run it) — bad_request at the
+    # door, NOT the policy-capacity too_long it was conflated with
+    # before PR 11 (too_long should mean "well-formed but over the
+    # context budget", so the admission counters stay truthful)
     r = eng.make_request(list(range(1, 10)), 2)
-    assert eng.submit(r) == REJECT_TOO_LONG
+    assert eng.submit(r) == REJECT_BAD_REQUEST
     # too long: prompt + new over pages_per_seq * page_len
     r = eng.make_request([1, 2, 3], 30)
     assert eng.submit(r) == REJECT_TOO_LONG
@@ -263,7 +267,8 @@ def test_rejection_reasons(params):
     assert eng2.submit(eng2.make_request([], 3)) == REJECT_BAD_REQUEST
     assert eng2.submit(eng2.make_request([1, 2], 0)) == REJECT_BAD_REQUEST
     counts = eng.metrics()["rejected_by_reason"]
-    assert counts[REJECT_TOO_LONG] == 2
+    assert counts[REJECT_TOO_LONG] == 1
+    assert counts[REJECT_BAD_REQUEST] == 1
     assert counts[REJECT_QUEUE_FULL] == 1
     assert eng2.metrics()["rejected_by_reason"][REJECT_BAD_REQUEST] == 2
 
@@ -466,6 +471,10 @@ def test_ramp_and_spike_profiles_shape_the_rate():
 @pytest.mark.parametrize("name,ar_count", [
     ("serve-decode", 2 * 2),          # 2 psums/block x 2 layers
     ("serve-prefill", 2 * 2 * 8),     # x max_prompt_len scan
+    # the start-offset variant scans max_prompt_len - start = 4
+    # positions: HALF serve-prefill's collectives — the compile-time
+    # proof of the prefill work a radix prefix hit skips
+    ("serve-prefill-cached", 2 * 2 * 4),
 ])
 def test_serve_signature_pins(strategy_report, name, ar_count):
     """TP serving traffic is the row-parallel all-reduce ONLY: exact

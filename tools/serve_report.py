@@ -18,13 +18,19 @@ different record kind.
 (key, host) group, the LATEST row regresses past the tolerance band
 against the median of up to ``--window`` priors — tokens/sec/chip
 falling by more than ``--tolerance`` (fractional, default 0.5 — CPU CI
-wall clocks are noisy) or p95 TTFT growing by more than it.  Groups
-with a single row pass with a "no baseline yet" note, and rows from
-different hosts never gate each other.  ``--check-ab`` adds the
-continuous-batching acceptance verdict: the latest row's A/B cell must
-show continuous strictly ahead of static in tokens delivered at the
-fixed budget (the deterministic virtual-clock comparison the driver
-records).
+wall clocks are noisy) or p95 TTFT growing by more than it.  On
+shared-prefix runs (``profile=shared`` in the key) ``prefix_hit_rate``
+is a gated key too: deterministic on the seeded trace, so it gates at
+the same band.  Groups with a single row pass with a "no baseline yet"
+note, and rows from different hosts never gate each other.
+``--check-ab`` adds the continuous-batching acceptance verdict: the
+latest row's A/B cell must show continuous strictly ahead of static in
+tokens delivered at the fixed budget (the deterministic virtual-clock
+comparison the driver records).  ``--check-prefix-ab`` adds the radix
+prefix cache's (PR 11): the latest row's cached-vs-cold cell must show
+``prefill_tokens_saved > 0``, a strictly higher cached virtual-clock
+tokens/sec/chip, tokens delivered strictly ahead at the fixed budget,
+and bitwise-matching token streams.
 
 Pure stdlib — no jax import, so the gate runs anywhere the JSON does.
 """
@@ -122,7 +128,39 @@ def check_group(
                 f"{(1 + tolerance):.2f}x band over the baseline "
                 f"{b_ttft * 1e3:.2f} ms"
             )
+    if _is_shared_prefix(latest):
+        # prefix_hit_rate is DETERMINISTIC on the seeded shared-prefix
+        # trace, so it gates like a perf key: a radix-tree or eviction
+        # regression shows up as a hit-rate collapse long before the
+        # noisy wall clocks notice
+        b_hit = _median([
+            r["prefix_hit_rate"] for r in base
+            if isinstance(r.get("prefix_hit_rate"), (int, float))
+        ])
+        l_hit = latest.get("prefix_hit_rate")
+        if b_hit and isinstance(l_hit, (int, float)):
+            if l_hit < b_hit * (1.0 - tolerance):
+                fails.append(
+                    f"prefix_hit_rate {l_hit:.3f} fell below the "
+                    f"{(1 - tolerance):.2f}x band under the baseline "
+                    f"{b_hit:.3f} on a shared-prefix run"
+                )
     return fails
+
+
+def _is_shared_prefix(rec: dict) -> bool:
+    key = rec.get("key")
+    return isinstance(key, dict) and key.get("profile") == "shared"
+
+
+def _ramp_or_top(rec: dict, name: str):
+    """A gated counter: top-level on a ledger row, under ``ramp`` in a
+    serve.json run doc — accept either, so the direct-doc fallback
+    (custom --ledger paths) judges the same keys."""
+    v = rec.get(name)
+    if v is None:
+        v = (rec.get("ramp") or {}).get(name)
+    return v
 
 
 def check_ab(recs: list[dict]) -> list[str]:
@@ -143,6 +181,87 @@ def check_ab(recs: list[dict]) -> list[str]:
             f"(budget {ab.get('budget_s')} s)"
         ]
     return []
+
+
+def check_prefix_ab(recs: list[dict]) -> list[str]:
+    """The radix-prefix-cache acceptance verdict on the latest row
+    (PR 11): the cached-vs-cold cell must exist and show real skipped
+    prefill work, a strict virtual-clock win at equal admission budget,
+    and bitwise-matching token streams."""
+    if not recs:
+        return []
+    latest = recs[-1]
+    pab = latest.get("prefix_ab")
+    if not isinstance(pab, dict):
+        return ["latest record carries no prefix A/B cell (run with "
+                "DDL25_SERVE_PREFIX=1 and without --no-serve-prefix-ab "
+                "to record one)"]
+    # a ledger row carries the flattened cell; a serve.json doc carries
+    # the driver's full output with cached/cold sub-dicts — accept both
+    cached_arm = pab.get("cached") or {}
+    cold_arm = pab.get("cold") or {}
+    pab = {
+        **pab,
+        "cached_tokens_per_sec_per_chip": pab.get(
+            "cached_tokens_per_sec_per_chip",
+            cached_arm.get("tokens_per_sec_per_chip"),
+        ),
+        "cold_tokens_per_sec_per_chip": pab.get(
+            "cold_tokens_per_sec_per_chip",
+            cold_arm.get("tokens_per_sec_per_chip"),
+        ),
+        "prefill_tokens_saved": pab.get(
+            "prefill_tokens_saved", cached_arm.get("prefill_tokens_saved")
+        ),
+    }
+    fails: list[str] = []
+    saved = pab.get("prefill_tokens_saved")
+    if not isinstance(saved, (int, float)) or saved <= 0:
+        fails.append(
+            f"prefix cache skipped no prefill work "
+            f"(prefill_tokens_saved={saved}); on a shared-prefix trace "
+            "the radix cache must hit"
+        )
+    cached_tps = pab.get("cached_tokens_per_sec_per_chip")
+    cold_tps = pab.get("cold_tokens_per_sec_per_chip")
+    if not (isinstance(cached_tps, (int, float))
+            and isinstance(cold_tps, (int, float))
+            and cached_tps > cold_tps):
+        fails.append(
+            f"cached engine not strictly faster on the virtual clock: "
+            f"cached {cached_tps} vs cold {cold_tps} tokens/sec/chip "
+            "at equal admission budget"
+        )
+    adv = pab.get("advantage_tokens")
+    if not isinstance(adv, (int, float)) or adv <= 0:
+        fails.append(
+            f"cached engine not ahead at the fixed budget: cached "
+            f"{pab.get('cached_tokens_at_budget')} vs cold "
+            f"{pab.get('cold_tokens_at_budget')} tokens (budget "
+            f"{pab.get('budget_s')} s)"
+        )
+    cmp_n = pab.get("compared_requests")
+    if pab.get("tokens_match") is not True or not (
+        isinstance(cmp_n, int) and cmp_n > 0
+    ):
+        # tokens_match is all() over the requests BOTH arms completed —
+        # vacuously True over an empty intersection, so zero compared
+        # requests is itself a gate failure, not a pass
+        fails.append(
+            "prefix-cached decode did not reproduce the cold path "
+            f"token-for-token (tokens_match={pab.get('tokens_match')} "
+            f"over {cmp_n} compared request(s); the comparison must "
+            "cover at least one request)"
+        )
+    if _is_shared_prefix(latest):
+        hit = _ramp_or_top(latest, "prefix_hit_rate")
+        if not isinstance(hit, (int, float)) or hit <= 0:
+            fails.append(
+                f"prefix_hit_rate={hit} on a shared-prefix run (gated "
+                "key: the seeded trace repeats its system prompts, so "
+                "a zero hit rate is a cache defect, not workload noise)"
+            )
+    return fails
 
 
 def histogram(xs: list[float], *, bins: int = 10, width: int = 40,
@@ -197,6 +316,17 @@ def format_run(doc: dict) -> str:
         f"({_fmt(ramp.get('page_pool_peak_occupancy'), 1, 100, '%')})"
         f"  pool-ok failures {ramp.get('pool_ok_failures')}",
     ]
+    prefix = ramp.get("prefix") or {}
+    if prefix.get("enabled"):
+        lines.append(
+            f"  prefix cache: hit rate "
+            f"{_fmt(ramp.get('prefix_hit_rate'), 1, 100, '%')} "
+            f"({prefix.get('hits')}/{prefix.get('lookups')} admitted)  "
+            f"prefill saved {ramp.get('prefill_tokens_saved')} tokens / "
+            f"{_fmt(ramp.get('prefill_flops_saved'), 2, 1e-6, ' MFLOP')}"
+            f"  cached pages {prefix.get('cached_pages')}  evictions "
+            f"{prefix.get('evictions')}"
+        )
     ab = doc.get("ab")
     if ab:
         lines += [
@@ -208,6 +338,27 @@ def format_run(doc: dict) -> str:
             f"tokens  static {ab.get('static_tokens_at_budget')} tokens  "
             f"advantage {ab.get('advantage_tokens')} "
             f"({_fmt(ab.get('advantage_frac'), 1, 100, '%')})",
+        ]
+    pab = doc.get("prefix_ab")
+    if pab:
+        cached = pab.get("cached") or {}
+        cold = pab.get("cold") or {}
+        lines += [
+            "",
+            "  cached-vs-cold prefix A/B (virtual clock, budget "
+            f"{_fmt(pab.get('budget_s'), 3)} s, equal admission "
+            "budget):",
+            f"    cached {pab.get('cached_tokens_at_budget')} tokens  "
+            f"cold {pab.get('cold_tokens_at_budget')} tokens  advantage "
+            f"{pab.get('advantage_tokens')} "
+            f"({_fmt(pab.get('advantage_frac'), 1, 100, '%')})",
+            f"    tokens/sec/chip cached "
+            f"{_fmt(cached.get('tokens_per_sec_per_chip'), 2)}"
+            f" vs cold "
+            f"{_fmt(cold.get('tokens_per_sec_per_chip'), 2)}"
+            f"  hit rate {_fmt(cached.get('prefix_hit_rate'), 1, 100, '%')}"
+            f"  saved {cached.get('prefill_tokens_saved')} tokens  "
+            f"tokens match {pab.get('tokens_match')}",
         ]
     if doc.get("ttft_s"):
         lines += ["", "  TTFT histogram:"] + histogram(doc["ttft_s"])
@@ -226,6 +377,7 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
         f"  {'when (utc)':<20}{'sha':<9}{'tok/s/chip':>11}"
         f"{'ttft p50':>11}{'ttft p95':>11}{'tok p95':>11}"
         f"{'adm':>5}{'rej':>5}{'pool%':>7}{'ab adv':>8}"
+        f"{'hit%':>7}{'saved':>7}{'pfx adv':>8}"
     )
     lines.append(cols)
     lines.append("  " + "-" * (len(cols) - 2))
@@ -238,6 +390,7 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
         )
         sha = (rec.get("git_sha") or "?")[:7]
         ab = rec.get("ab") or {}
+        pab = rec.get("prefix_ab") or {}
         lines.append(
             f"  {when:<20}{sha:<9}"
             f"{_fmt(rec.get('tokens_per_sec_per_chip'), 2):>11}"
@@ -248,6 +401,9 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
             f"{rec.get('rejected', '?'):>5}"
             f"{_fmt(rec.get('page_pool_peak_occupancy'), 0, 100, '%'):>7}"
             f"{_fmt(ab.get('advantage_tokens'), 0):>8}"
+            f"{_fmt(rec.get('prefix_hit_rate'), 0, 100, '%'):>7}"
+            f"{_fmt(rec.get('prefill_tokens_saved'), 0):>7}"
+            f"{_fmt(pab.get('advantage_tokens'), 0):>8}"
         )
     return "\n".join(lines)
 
@@ -278,8 +434,13 @@ def main(argv=None) -> int:
                     help="also fail when the latest row's "
                          "continuous-vs-static A/B does not show "
                          "continuous strictly ahead (implies --check)")
+    ap.add_argument("--check-prefix-ab", action="store_true",
+                    help="also fail when the latest row's cached-vs-"
+                         "cold prefix A/B does not show skipped prefill "
+                         "work, a strict virtual-clock win, and "
+                         "matching token streams (implies --check)")
     args = ap.parse_args(argv)
-    if args.check_ab:
+    if args.check_ab or args.check_prefix_ab:
         args.check = True  # a verdict nobody reads is not a gate
 
     if args.run_dir is None and not args.ledger_only:
@@ -314,19 +475,26 @@ def main(argv=None) -> int:
     for key, recs in groups.items():
         fails: list[str] = []
         note = None
-        if args.check_ab and (ab_scope is None or key == ab_scope):
-            # the A/B verdict needs no baseline: a single row gates
-            fails += check_ab(recs)
+        if ab_scope is None or key == ab_scope:
+            # the A/B verdicts need no baseline: a single row gates
+            if args.check_ab:
+                fails += check_ab(recs)
+            if args.check_prefix_ab:
+                fails += check_prefix_ab(recs)
         if len(recs) < 2:
             if not fails:
                 note = "no baseline yet (single record)"
         else:
             fails += check_group(recs, args.tolerance, args.window)
         verdicts[key] = {"fails": fails, "note": note}
-    if args.check_ab and ab_scope is not None and ab_scope not in groups:
+    if ((args.check_ab or args.check_prefix_ab)
+            and ab_scope is not None and ab_scope not in groups):
         # the run under test never landed in this ledger (custom
         # --ledger path): judge its serve.json directly
-        verdicts[ab_scope] = {"fails": check_ab([doc]), "note": None}
+        fails = check_ab([doc]) if args.check_ab else []
+        if args.check_prefix_ab:
+            fails += check_prefix_ab([doc])
+        verdicts[ab_scope] = {"fails": fails, "note": None}
     bad = sum(len(v["fails"]) for v in verdicts.values())
 
     print(f"serve ledger: {args.ledger}  ({len(records)} record(s), "
@@ -345,6 +513,8 @@ def main(argv=None) -> int:
         if bad:
             return 1
         ab_note = ", A/B advantage verified" if args.check_ab else ""
+        if args.check_prefix_ab:
+            ab_note += ", prefix A/B advantage verified"
         print(f"\nserve check OK: {len(groups)} key(s) within the "
               f"{args.tolerance:.2f} tolerance band{ab_note}",
               file=sys.stderr)
