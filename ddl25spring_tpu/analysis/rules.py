@@ -60,6 +60,26 @@ H010      warn      overlap window priced under the measured micro-cost
                     :func:`ddl25spring_tpu.analysis.engine.
                     attach_measured_costs` when a perf record is in
                     hand (``graft_lint --perf-ledger``, perfscope)
+H011      error     implicit reshard: a non-scalar collective kind in
+                    the compiled HLO that the strategy's ``describe()``
+                    signature neither declares nor forbids — XLA's
+                    partitioner inserted traffic the author never
+                    declared (:mod:`ddl25spring_tpu.analysis.
+                    shard_flow`)
+H012      error/    rule-coverage defect in a partition-rule table
+          warn      (:mod:`ddl25spring_tpu.parallel.rules`): a param
+                    leaf no rule matches (error), a leaf matched by
+                    two rules (warn: order silently load-bearing), or
+                    a rule shadowed so it can never fire (warn)
+H013      error     cross-program layout mismatch: a ZeRO-family
+                    step's saved param/opt-state sharding off
+                    ``ft/reshard``'s ``[n, k]``/``[L, n, k]``
+                    checkpoint contract, or serve prefill/decode
+                    disagreeing on the paged-KV pool split.  The
+                    per-program half runs in the pack; the
+                    program-pair half emits from :func:`ddl25spring_
+                    tpu.analysis.shard_flow.check_layout_contracts`
+                    (``graft_lint --shard-flow``)
 ========  ========  ====================================================
 
 Source-level (AST) rules S101-S103 live in
@@ -606,6 +626,130 @@ def rule_participant_stream_mismatch(ctx) -> list[Finding]:
             ),
         ))
     return out
+
+
+@hlo_rule("H011")
+def rule_implicit_reshard(ctx) -> list[Finding]:
+    """A collective kind present in the compiled program but absent
+    from the strategy's declared signature — neither pinned with bounds
+    nor listed forbidden.  The signature gate cannot see it (it only
+    judges what the author wrote down); this rule closes that hole, so
+    a partitioner-inserted reshard can never ride along unaccounted.
+    One finding per undeclared kind (the example site named), scalar
+    bookkeeping exempt."""
+    from ddl25spring_tpu.obs.xla_analytics import _COLLECTIVE_KINDS
+
+    expected = (ctx.report or {}).get("expected")
+    if not expected:
+        return []  # no declared signature: no claim to hold the HLO to
+    declared = {k for k in expected if k in _COLLECTIVE_KINDS}
+    declared |= set(expected.get("forbidden") or ())
+    scalar = int(
+        expected.get("scalar_bytes", ctx.thresholds.get("scalar_bytes", 0))
+    )
+    per_kind: dict[str, list[dict]] = {}
+    for op in ctx.ops:
+        if op["kind"] in declared or op["result_bytes"] <= scalar:
+            continue
+        per_kind.setdefault(op["kind"], []).append(op)
+    out = []
+    for kind in sorted(per_kind):
+        ops = per_kind[kind]
+        total = sum(o["result_bytes"] * o["count"] for o in ops)
+        out.append(Finding(
+            rule="H011", severity="error", strategy=ctx.strategy,
+            op=ops[0].get("name"), bytes=total,
+            source=ops[0].get("source"),
+            message=(
+                f"implicit reshard: {len(ops)} {kind} site(s) moving "
+                f"{_fmt_bytes(total)} total that the describe() "
+                "signature neither declares nor forbids — XLA inserted "
+                "traffic the author never declared"
+            ),
+            fix_hint=(
+                "either the sharding flow is wrong (fix the specs so "
+                "the reshard disappears) or the signature is incomplete "
+                f"(declare {kind} with bounds/axes, or forbid it, in "
+                "describe())"
+            ),
+        ))
+    return out
+
+
+@hlo_rule("H012")
+def rule_partition_coverage(ctx) -> list[Finding]:
+    """The coverage proof for rule-table strategies: every param leaf
+    matched exactly once, every rule reachable.  Judged from the
+    serialized table + leaf paths the describe() meta carries — the
+    evidence survives JSON round-trips, so the proof re-runs on any
+    stored report."""
+    meta = ((ctx.report or {}).get("meta")) or {}
+    table = meta.get("rule_table")
+    if not table:
+        return []  # not a rule-table strategy: no table to prove
+    from ddl25spring_tpu.analysis.shard_flow import coverage_defects
+
+    paths = meta.get("param_paths") or []
+    out = []
+    for d in coverage_defects(table, paths):
+        severe = d["defect"] in ("unmatched", "bad-table")
+        out.append(Finding(
+            rule="H012",
+            severity="error" if severe else "warn",
+            strategy=ctx.strategy,
+            op=d.get("path") or d.get("pattern"),
+            message=(
+                f"rule-coverage defect [{d['defect']}] in table "
+                f"{table.get('name', '?')!r}: {d['detail']}"
+            ),
+            fix_hint=(
+                "edit the table until every leaf matches exactly one "
+                "rule and every rule fires (parallel/rules.py; "
+                "rule_coverage() shows the full match matrix)"
+            ),
+        ))
+    return out
+
+
+@hlo_rule("H013")
+def rule_saved_layout_contract(ctx) -> list[Finding]:
+    """The per-program half of the cross-program layout contract: a
+    ZeRO-family step's saved state must shard exactly as ``ft/reshard``
+    re-lands it (rank-2 ``[n, k]`` on dim 0, rank-3 ``[L, n, k]`` on
+    dim 1, row count == the shard axis) — walked off the compiled
+    program's own entry-parameter shardings, so the pin can never
+    drift from what XLA actually laid out."""
+    if not ctx.report:
+        return []
+    from ddl25spring_tpu.analysis.shard_flow import saved_layout_findings
+
+    report = dict(ctx.report)
+    report.setdefault("strategy", ctx.strategy)
+    report.setdefault("entry_params", ctx.entry_params)
+    return saved_layout_findings(report)
+
+
+def h013_finding(
+    strategy: str | None,
+    op: str | None,
+    message: str,
+    bytes: int | None = None,
+) -> Finding:
+    """One H013 cross-program layout-mismatch finding — the constructor
+    lives here so the rule pack owns every severity/message, while the
+    emission points are the pack's per-program walk above and
+    :func:`ddl25spring_tpu.analysis.shard_flow.check_layout_contracts`
+    (the only place several compiled programs are in hand)."""
+    return Finding(
+        rule="H013", severity="error", strategy=strategy, op=op,
+        bytes=bytes, message=message,
+        fix_hint=(
+            "make the layouts agree: fix the sharding specs (or the "
+            "save layout in ft/reshard's contract / the serve pool "
+            "specs) so every program in the round-trip sees the same "
+            "split"
+        ),
+    )
 
 
 def h010_finding(strategy: str | None, rec: dict[str, Any]) -> Finding:

@@ -754,8 +754,13 @@ def analyze_schedule(
 def discipline_of(meta: dict[str, Any] | None) -> str:
     """A strategy's issue discipline from its describe() meta: overlap
     and prefetch variants commit to issue-at-readiness; everything else
-    issues on the committed schedule."""
+    issues on the committed schedule.  Rule-table strategies
+    (parallel/rules.py) carry the discipline as DATA in the table —
+    ``meta["discipline"]`` — which takes precedence: the strategy
+    triple is mesh + rule table + issue discipline."""
     meta = meta or {}
+    if meta.get("discipline") in ("sync", "overlap"):
+        return meta["discipline"]
     return "overlap" if (meta.get("overlap") or meta.get("prefetch")) else "sync"
 
 

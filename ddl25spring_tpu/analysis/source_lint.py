@@ -42,13 +42,18 @@ from ddl25spring_tpu.analysis.rules import Finding
 # path, and sentinels/perfscope compile guards and micro-benches INTO
 # programs — an env read inside any of them silently forks compiled
 # program structure on ambient process state (PR-9 satellite: scope
-# grown from parallel/+benchmarks to the ft and obs trace surfaces).
+# grown from parallel/+benchmarks to the ft and obs trace surfaces;
+# PR-12 satellite: serve/ joins — the driver/engine resolve every
+# DDL25_SERVE_* knob through utils.config.env_int at the entry point,
+# and this scope keeps raw os.environ reads from creeping back into
+# the compiled prefill/decode build path).
 _TRACED_CODE_DIRS = (
     "ddl25spring_tpu/parallel/",
     "ddl25spring_tpu/ops/",
     "ddl25spring_tpu/models/",
     "ddl25spring_tpu/benchmarks.py",
     "ddl25spring_tpu/ft/",
+    "ddl25spring_tpu/serve/",
     "ddl25spring_tpu/obs/sentinels.py",
     "ddl25spring_tpu/obs/perfscope.py",
 )

@@ -78,6 +78,76 @@ def _parse_toml_text(text: str) -> dict[str, Any]:
         return _parse_mini(text)
 
 
+# the basic-string escapes tomllib honors (TOML 1.0 §String; \uXXXX /
+# \UXXXXXXXX handled separately below); anything else after a
+# backslash — \#, \q, a stray trailing \ — is invalid TOML that
+# tomllib rejects, so the mini parser must reject it too rather than
+# silently keeping bytes 3.11 CI would refuse
+_STRING_ESCAPES = {
+    '"': '"', "\\": "\\", "b": "\b", "t": "\t", "n": "\n",
+    "f": "\f", "r": "\r",
+}
+
+
+def _scan_string(val: str, lineno: int) -> tuple[str, str]:
+    """Unescape the leading double-quoted string of ``val`` (which must
+    start at its opening quote); returns ``(content, rest_after_quote)``.
+
+    Character-by-character with real escape tracking: the previous
+    one-char-lookbehind treated the closing quote of ``"tail\\\\"`` as
+    escaped (the backslash before it is itself escaped) and mis-scanned
+    past it — which, with a ``#`` later on the line, silently swallowed
+    the comment into the hunt for a closing quote."""
+    out: list[str] = []
+    i = 1
+    while i < len(val):
+        c = val[i]
+        if c == '"':
+            return "".join(out), val[i + 1:]
+        if c == "\\":
+            if i + 1 >= len(val):
+                raise ValueError(
+                    f"waivers.toml:{lineno}: unterminated string"
+                )
+            esc = val[i + 1]
+            if esc in ("u", "U"):
+                # \uXXXX / \UXXXXXXXX are VALID TOML — accepting them
+                # here keeps parity with tomllib on 3.11 CI.  Strictly
+                # hex digits only (int(_, 16) would take '00_4'!) and
+                # no lone surrogates — both are rejected by tomllib's
+                # _parse_hex_char, so they must be rejected here too
+                n = 4 if esc == "u" else 8
+                hexs = val[i + 2:i + 2 + n]
+                if len(hexs) < n or not all(
+                    c in "0123456789abcdefABCDEF" for c in hexs
+                ):
+                    raise ValueError(
+                        f"waivers.toml:{lineno}: truncated or non-hex "
+                        f"\\{esc} escape '{hexs}' in string"
+                    )
+                cp = int(hexs, 16)
+                if 0xD800 <= cp <= 0xDFFF or cp > 0x10FFFF:
+                    raise ValueError(
+                        f"waivers.toml:{lineno}: \\{esc} escape "
+                        f"'{hexs}' is not a Unicode scalar value"
+                    )
+                out.append(chr(cp))
+                i += 2 + n
+                continue
+            if esc not in _STRING_ESCAPES:
+                raise ValueError(
+                    f"waivers.toml:{lineno}: invalid escape "
+                    f"'\\{esc}' in string (tomllib rejects it; drop "
+                    "the backslash or use a supported escape)"
+                )
+            out.append(_STRING_ESCAPES[esc])
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    raise ValueError(f"waivers.toml:{lineno}: unterminated string")
+
+
 def _parse_mini(text: str) -> dict[str, Any]:
     """The fallback parser: ``[[waiver]]`` array-of-tables whose values
     are double-quoted strings.  Anything fancier is a loud error — the
@@ -97,23 +167,17 @@ def _parse_mini(text: str) -> dict[str, Any]:
             key, _, val = line.partition("=")
             key, val = key.strip(), val.strip()
             if val.startswith('"'):
-                end = val.find('"', 1)
-                while end > 0 and val[end - 1] == "\\":
-                    end = val.find('"', end + 1)
-                if end < 0:
-                    raise ValueError(
-                        f"waivers.toml:{lineno}: unterminated string"
-                    )
+                content, rest = _scan_string(val, lineno)
                 # after the closing quote only a comment may follow —
                 # anything else is a malformed entry that would silently
                 # widen the waiver (and diverge from tomllib on 3.11)
-                rest = val[end + 1:].strip()
+                rest = rest.strip()
                 if rest and not rest.startswith("#"):
                     raise ValueError(
                         f"waivers.toml:{lineno}: unexpected content "
                         f"after string value: {rest!r}"
                     )
-                cur[key] = val[1:end].replace('\\"', '"')
+                cur[key] = content
                 continue
         raise ValueError(
             f"waivers.toml:{lineno}: only [[table]] headers and "

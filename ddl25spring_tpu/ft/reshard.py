@@ -41,6 +41,18 @@ from typing import Any
 import jax
 import numpy as np
 
+# The checkpoint layout contract, as data: which dimension of a saved
+# sharded leaf carries the per-device rows — rank 2 = the padded
+# ``[n, k]`` layout (rows on dim 0), rank 3 = the layer-stacked
+# ``[L, n, k]`` layout (rows on dim 1).  :func:`reshard_leaf`'s refit
+# math below is ONLY exact under this contract (row-major flatten puts
+# all padding at the tail); the static sharding-flow verifier
+# (:mod:`ddl25spring_tpu.analysis.shard_flow`, rule H013) walks every
+# ZeRO-family train step's entry-parameter shardings against it at
+# compile time, so a transposed ``[k, n]`` save layout fails CI instead
+# of silently restoring garbage after the next preemption.
+SAVED_SHARD_DIMS: dict[int, int] = {2: 0, 3: 1}
+
 
 def _refit_flat(flat: np.ndarray, target_len: int, name: str) -> np.ndarray:
     """Zero-pad or zero-truncate a flattened shard buffer to

@@ -45,12 +45,17 @@ COMPILE_REPORT_BASENAME = "compile_report.json"
 # like tp but forward-only); PR 11 adds the prefix cache's start-offset
 # prefill variant (serve-prefill-cached), whose SHORTER scan — fewer
 # all-reduces than serve-prefill's — is the compile-time proof of the
-# prefill FLOPs a radix hit skips.  All seventeen share the tests'
-# lower-once compile cache, so tier-1 pays each compile exactly once.
+# prefill FLOPs a radix hit skips.  PR 12 adds the two partition-rule-
+# table strategies (dp-rules / zero3-rules: the strategy is a mesh +
+# regex rule table + issue discipline, parallel/rules.py), pinned
+# bitwise-identical to their bespoke twins and coverage-proven by the
+# sharding-flow verifier (analysis/shard_flow.py, H011-H013).  All
+# nineteen share the tests' lower-once compile cache, so tier-1 pays
+# each compile exactly once.
 DEFAULT_STRATEGIES = (
-    "dp", "dp-overlap", "zero1", "zero1-overlap", "zero2",
+    "dp", "dp-overlap", "dp-rules", "zero1", "zero1-overlap", "zero2",
     "zero2-overlap", "zero3", "zero3-prefetch", "zero3-overlap",
-    "pipeline", "het_pipeline", "tp", "sp", "ep",
+    "zero3-rules", "pipeline", "het_pipeline", "tp", "sp", "ep",
     "serve-decode", "serve-prefill", "serve-prefill-cached",
 )
 

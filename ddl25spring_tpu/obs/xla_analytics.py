@@ -76,6 +76,9 @@ _CALLEE_RE = re.compile(
 _BRANCHES_RE = re.compile(r"branches=\{([^}]*)\}")
 _TRIP_RE = re.compile(r'known_trip_count[\\"=:{\s]+n[\\"=:\s]+(\d+)')
 
+_SHARDING_TILE_RE = re.compile(r"devices=\[([\d,]+)\]")
+_LAST_TILE_DIMS_RE = re.compile(r"last_tile_dims=\{([^}]*)\}")
+
 
 def _shape_bytes(type_str: str) -> int:
     """Total bytes of an HLO result type string (handles tuples by summing
@@ -236,6 +239,74 @@ def parse_op_defs(hlo_text: str) -> dict[str, dict[str, dict[str, Any]]]:
     return out
 
 
+def _sharding_attr_of_line(line: str) -> str | None:
+    """The brace-balanced body of a ``sharding={...}`` op attribute
+    (``last_tile_dims={...}`` nests braces, so a ``[^}]*`` regex would
+    truncate it)."""
+    start = line.find("sharding={")
+    if start < 0:
+        return None
+    i = line.index("{", start)
+    depth = 0
+    for j in range(i, len(line)):
+        if line[j] == "{":
+            depth += 1
+        elif line[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return line[i + 1:j]
+    return None
+
+
+def parse_sharding(attr: str | None) -> dict[str, Any] | None:
+    """Structured view of one HLO ``sharding=`` annotation body — the
+    substrate the sharding-flow verifier walks
+    (:mod:`ddl25spring_tpu.analysis.shard_flow`).
+
+    Returns ``{"raw", "replicated", "maximal", "manual", "tile"``
+    (the ``devices=[...]`` tile-assignment dims), ``"trailing_subgroups"``
+    (trailing tile dims that replicate/are manual rather than partition
+    data dims), ``"partitioned_dims"`` (data-dim indices with >1
+    partition) and ``"partitions"`` (per partitioned dim, its factor)}``
+    — or None when the op carries no annotation.  A rank change between
+    the global and the per-device local shape never matters here: the
+    tile dims index GLOBAL data dimensions.
+    """
+    if attr is None:
+        return None
+    out: dict[str, Any] = {
+        "raw": attr,
+        "replicated": attr.strip() == "replicated",
+        "maximal": attr.strip().startswith("maximal"),
+        "manual": attr.strip() == "manual",
+        "tile": None,
+        "trailing_subgroups": 0,
+        "partitioned_dims": [],
+        "partitions": {},
+    }
+    m = _SHARDING_TILE_RE.search(attr)
+    if not m:
+        return out
+    tile = [int(x) for x in m.group(1).split(",")]
+    out["replicated"] = False
+    trailing = 0
+    ltd = _LAST_TILE_DIMS_RE.search(attr)
+    if ltd:
+        trailing = len([x for x in ltd.group(1).split(",") if x.strip()])
+    elif "last_tile_dim_replicate" in attr:
+        trailing = 1
+    out["tile"] = tile
+    out["trailing_subgroups"] = trailing
+    data_dims = tile[: len(tile) - trailing] if trailing else tile
+    out["partitioned_dims"] = [
+        i for i, d in enumerate(data_dims) if d > 1
+    ]
+    out["partitions"] = {
+        i: d for i, d in enumerate(data_dims) if d > 1
+    }
+    return out
+
+
 def parse_input_output_aliases(hlo_text: str) -> list[dict[str, Any]]:
     """Entries of the module-level ``input_output_alias`` table — the
     buffers XLA reuses in place (donated params/opt-state).  Each entry:
@@ -274,7 +345,11 @@ def parse_entry_parameters(hlo_text: str) -> list[dict[str, Any]]:
     "type", "arg"}`` per input buffer, where ``arg`` is the jax-level
     argument path XLA records in the op metadata (``params['w1']``,
     ``opt_state[0]...``, ``batch[0]``) when available — the names the
-    donation-miss rule (H005) reports."""
+    donation-miss rule (H005) reports.  ``sharding`` is the parsed
+    ``sharding=`` annotation (:func:`parse_sharding`; None when the
+    parameter carries none) — the per-program layout facts the
+    sharding-flow verifier's cross-program contract checks walk
+    (:mod:`ddl25spring_tpu.analysis.shard_flow`, rule H013)."""
     comps, entry = _split_computations(hlo_text)
     if entry is None:
         return []
@@ -292,6 +367,7 @@ def parse_entry_parameters(hlo_text: str) -> list[dict[str, Any]]:
             "bytes": _shape_bytes(m.group(2)),
             "type": m.group(2),
             "arg": arg.group(1) if arg else None,
+            "sharding": parse_sharding(_sharding_attr_of_line(line)),
         })
     out.sort(key=lambda p: p["number"])
     return out
@@ -826,6 +902,23 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "axes": ("model",), "default_mesh": (2,),
         "kwargs": {"program": "prefill", "start": 4},
     },
+    # the partition-rule-engine variants (PR 12): the strategy is DATA —
+    # a mesh shape + ordered regex rule table + issue discipline
+    # (parallel/rules.py) — lowered through the generic RulePartitioner
+    # and pinned bitwise-identical to the bespoke dp / zero3 builders
+    # (tests/test_shard_flow.py); their tables are proven covered (every
+    # param leaf matched exactly once, no shadowed rule) by the
+    # sharding-flow verifier's H012 (analysis/shard_flow.py)
+    "dp-rules": {
+        "module": "ddl25spring_tpu.parallel.rules",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"table": "dp"},
+    },
+    "zero3-rules": {
+        "module": "ddl25spring_tpu.parallel.rules",
+        "axes": ("data",), "default_mesh": (4,),
+        "kwargs": {"table": "zero3"},
+    },
 }
 
 
@@ -885,6 +978,7 @@ def compile_strategy(
     name: str,
     mesh_sizes: tuple[int, ...] | None = None,
     lint: bool = True,
+    keep_hlo: bool = False,
     **overrides: Any,
 ) -> dict[str, Any]:
     """Lower + compile one strategy on a fake CPU mesh and analyze it.
@@ -893,7 +987,12 @@ def compile_strategy(
     ``{"strategy", "mesh", "lowered", "expected",
     "signature_violations", "findings"}`` — the last from the static
     hazard analyzer (:mod:`ddl25spring_tpu.analysis`), run over the same
-    optimized HLO unless ``lint=False``.  A strategy whose trace/compile
+    optimized HLO unless ``lint=False``.  ``keep_hlo=True`` additionally
+    stores the optimized-HLO text under ``report["hlo_text"]`` — the
+    tests' lower-once cache and ``graft_lint --shard-flow`` opt in so
+    the sharding-flow walk and the bitwise rule-table pins reuse the one
+    compile; the default stays off so JSON artifacts never carry
+    megabytes of HLO.  A strategy whose trace/compile
     fails on this jax (e.g. the homogeneous-pipeline grad path pre-VMA)
     degrades to ``{"strategy", "error"}`` instead of raising — a dead
     strategy must not cost the others' reports.
@@ -920,6 +1019,8 @@ def compile_strategy(
             err["mesh_requested"] = list(mesh_sizes or ())
         return err
     report["strategy"] = name
+    if keep_hlo:
+        report["hlo_text"] = hlo_text
     report["mesh"] = {
         ax: int(s) for ax, s in zip(mesh.axis_names, mesh.devices.shape)
     }

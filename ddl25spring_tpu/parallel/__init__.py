@@ -18,6 +18,14 @@ from ddl25spring_tpu.parallel.pipeline import (
     make_pipeline_train_step,
     shard_staged_params,
 )
+from ddl25spring_tpu.parallel.rules import (
+    PartitionRule,
+    Partitioner,
+    RulePartitioner,
+    RuleTable,
+    match_partition_rules,
+    rule_coverage,
+)
 from ddl25spring_tpu.parallel.sp import (
     make_sp_loss,
     make_sp_train_step,
@@ -50,6 +58,12 @@ __all__ = [
     "make_pipeline_loss",
     "make_pipeline_train_step",
     "shard_staged_params",
+    "PartitionRule",
+    "Partitioner",
+    "RulePartitioner",
+    "RuleTable",
+    "match_partition_rules",
+    "rule_coverage",
     "make_sp_loss",
     "make_sp_train_step",
     "make_tp_loss",

@@ -441,6 +441,9 @@ def describe(
     # fwd: n ring steps x (k, v, pos) rotations per layer + 1 targets hop;
     # bwd replays the ring (cotangent rotations) — floor at the fwd share
     min_hops = cfg.n_layers * n
+    param_bytes = sum(
+        l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
+    )
     return {
         "fn": step,
         "args": (params, tx.init(params), tokens),
@@ -455,6 +458,16 @@ def describe(
             "scalar_bytes": 64,
             "collective-permute": {
                 "min_count": min_hops,
+                "axes": axes,
+            },
+            # params are REPLICATED under SP, so the backward must sync
+            # the full grad tree — exactly one param_bytes of all-reduce
+            # (H011 surfaced this as real-but-undeclared traffic when
+            # the sharding-flow verifier first ran; the tight band means
+            # a second sync or a silent sharding collapse both trip)
+            "all-reduce": {
+                "min_bytes": param_bytes,
+                "max_bytes": param_bytes + 256,
                 "axes": axes,
             },
             **({"forbidden": ["all-to-all"]} if mode == "ring" else {}),

@@ -347,6 +347,17 @@ def describe(
                 "axes": axes,
                 "min_bytes": 2 * cfg.n_layers * act_bytes,
             },
+            # the vocab-sharded loss assembly: the per-shard lse
+            # all-gather ([t, B, L-1]) with its reduce-scatter transpose
+            # in the backward, plus one partitioner-chosen all-to-all
+            # resharding the gathered combine — O(B*L*t) each,
+            # V-independent.  H011 (the sharding-flow verifier)
+            # surfaced all three as traffic this signature never
+            # declared; ceilinged at one activation so a densified
+            # gather can never hide under the declaration
+            "all-gather": {"max_bytes": act_bytes, "axes": axes},
+            "reduce-scatter": {"max_bytes": act_bytes, "axes": axes},
+            "all-to-all": {"max_bytes": act_bytes, "axes": axes},
             "forbidden": ["collective-permute"],
             # the step donates its params/opt-state (floor 1: "donates at
             # all"; the byte-exact floors live on the dp/zero/ep pins)

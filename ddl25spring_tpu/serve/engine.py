@@ -1256,6 +1256,15 @@ class ServeEngine:
 
 # ------------------------------------------------------ registry hook
 
+# The TP page-pool layout contract, as data: the k/v page buffers
+# ``[n_pages+1, L, page_len, H, hd]`` shard exactly ONE dimension — the
+# head dim — over the model axis (each shard caches its local ``H/t``
+# heads).  Prefill writes the pages decode reads, so every compiled
+# serve program must agree on this split; the sharding-flow verifier
+# (analysis/shard_flow.py, rule H013) walks each program pair's
+# entry-parameter shardings against it in `graft_lint --shard-flow`.
+KV_POOL_HEAD_DIM = 3
+
 
 def make_tp_serve_program(
     cfg: LlamaConfig,
@@ -1296,7 +1305,12 @@ def make_tp_serve_program(
         cfg, n_pages=n_pages, page_len=page_len, max_slots=max_slots,
         pages_per_seq=pages_per_seq,
     )
-    kv_spec = P(None, None, None, model_axis)  # heads sharded
+    # heads sharded, everything else replicated — spec length follows
+    # the rank-5 [n_pages+1, L, page_len, H, hd] buffer so the split
+    # always lands on KV_POOL_HEAD_DIM even if the contract dim moves
+    kv_spec = P(*(
+        model_axis if d == KV_POOL_HEAD_DIM else None for d in range(5)
+    ))
     pool_specs = {
         k: (kv_spec if k in ("k", "v") else P()) for k in pool
     }
@@ -1431,6 +1445,9 @@ def describe(mesh, program: str = "decode", model_axis: str = "model",
             "max_slots": max_slots,
             "n_pages": max_slots * pages_per_seq,
             "tp": t,
+            # the declared pool split the H013 pair check holds every
+            # compiled serve program to (see KV_POOL_HEAD_DIM)
+            "kv_sharded_dim": KV_POOL_HEAD_DIM,
             **({"max_prompt_len": max_prompt_len,
                 "prefill_batch": prefill_batch,
                 "start": start}

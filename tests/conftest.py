@@ -58,11 +58,15 @@ _lowered_once: dict = {}
 
 
 def cached_strategy_report(name: str) -> dict:
-    """Compile + analyze one registered strategy, once per session."""
+    """Compile + analyze one registered strategy, once per session.
+    ``keep_hlo=True``: the report carries the optimized-HLO text, so the
+    bitwise rule-table pins and the sharding-flow walks
+    (test_shard_flow.py) reuse this one compile instead of paying their
+    own."""
     from ddl25spring_tpu.obs import xla_analytics as xa
 
     if name not in _strategy_reports:
-        _strategy_reports[name] = xa.compile_strategy(name)
+        _strategy_reports[name] = xa.compile_strategy(name, keep_hlo=True)
     r = _strategy_reports[name]
     assert "error" not in r, f"{name} failed to compile: {r.get('error')}"
     return r

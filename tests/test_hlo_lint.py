@@ -6,8 +6,9 @@ Two contracts pinned here:
    synthetic HLO module (and each S-rule one pitfall Python snippet)
    proving the rule detects what it claims, plus a near-miss showing it
    stays quiet when the hazard is absent.
-2. **Every strategy is clean** — all fourteen registered parallel
-   strategies compile with ZERO unwaived findings on this jax, the same
+2. **Every strategy is clean** — every registered strategy (all
+   nineteen, the rule-table variants included) compiles with ZERO
+   unwaived findings on this jax, the same
    way PR 2 pinned their collective signatures.  A refactor that
    introduces a sync-collective pileup, a donation miss, an axis leak,
    or a participant-stream mismatch fails here (and the ``graft-lint``
@@ -808,6 +809,66 @@ def test_mini_parser_matches_tomllib_on_crlf_line_endings():
     tl = _tomllib()
     if tl is not None:
         assert mini == tl.loads(text)
+
+
+def test_mini_parser_matches_tomllib_on_escaped_hash_and_backslash_tail():
+    """PR-12 satellite: the one-char-lookbehind quote scanner mis-read
+    a string ending in an ESCAPED BACKSLASH (``"...\\\\"``) — the
+    closing quote looked escaped, so the scanner hunted past it and,
+    with a ``#`` comment on the line, swallowed the comment while
+    looking for a closing quote that never came (a loud failure on a
+    VALID file).  And ``\\#`` — not a TOML escape — parsed silently
+    where tomllib rejects it: a waiver that loads on the 3.10 build
+    image and crashes 3.11 CI.  Both halves pinned against tomllib."""
+    from ddl25spring_tpu.analysis.waivers import _parse_mini
+
+    # a reason ending in a literal backslash, with a trailing comment
+    text = (
+        '[[waiver]]\n'
+        'rule = "H001"\n'
+        'reason = "win path C:\\\\temp\\\\" # checkout note\n'
+    )
+    mini = _parse_mini(text)
+    assert mini["waiver"][0]["reason"] == "win path C:\\temp\\"
+    tl = _tomllib()
+    if tl is not None:
+        assert mini == tl.loads(text)
+
+    # an escaped '#' inside the reason string: INVALID TOML — both
+    # parsers must refuse (silent acceptance here is the divergence)
+    bad = '[[waiver]]\nrule = "H001"\nreason = "keep the \\# literal"\n'
+    with pytest.raises(ValueError, match="invalid escape"):
+        _parse_mini(bad)
+    if tl is not None:
+        with pytest.raises(Exception):
+            tl.loads(bad)
+
+    # a PLAIN '#' inside the string (no escape) stays legal, comment
+    # detection untouched
+    ok = _parse_mini(
+        '[[waiver]]\nrule = "H001"\nreason = "a # inside" # real comment\n'
+    )
+    assert ok["waiver"][0]["reason"] == "a # inside"
+
+    # \uXXXX / \UXXXXXXXX are VALID TOML — the mini parser must accept
+    # them exactly as tomllib does (review fix: rejecting them crashed
+    # the 3.10 image on a file 3.11 CI accepts)
+    uni = (
+        '[[waiver]]\nrule = "H001"\n'
+        'reason = "caf\\u00e9 \\U0001F600"\n'
+    )
+    mini = _parse_mini(uni)
+    assert mini["waiver"][0]["reason"] == "caf\u00e9 \U0001F600"
+    if tl is not None:
+        assert mini == tl.loads(uni)
+    with pytest.raises(ValueError, match="truncated"):
+        _parse_mini('[[waiver]]\nrule = "H001"\nreason = "x\\u00"\n')
+    # int(_, 16) would silently take '00_4' — strict hex digits only,
+    # and lone surrogates are not scalar values (tomllib rejects both)
+    with pytest.raises(ValueError, match="non-hex"):
+        _parse_mini('[[waiver]]\nrule = "H001"\nreason = "x\\u00_4y"\n')
+    with pytest.raises(ValueError, match="scalar"):
+        _parse_mini('[[waiver]]\nrule = "H001"\nreason = "x\\uD800y"\n')
 
 
 def test_mini_parser_rejects_table_of_tables_loudly():

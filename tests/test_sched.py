@@ -432,8 +432,9 @@ def test_every_registered_strategy_carries_a_sched_report():
     from ddl25spring_tpu.obs.compile_report import DEFAULT_STRATEGIES
 
     assert set(DEFAULT_STRATEGIES) == set(xa.STRATEGIES)
-    # 14 training + 2 serving (PR 10) + the cached-prefill variant (PR 11)
-    assert len(DEFAULT_STRATEGIES) == 17
+    # 14 training + 2 serving (PR 10) + the cached-prefill variant
+    # (PR 11) + the 2 partition-rule-table strategies (PR 12)
+    assert len(DEFAULT_STRATEGIES) == 19
     for name in DEFAULT_STRATEGIES:
         r = cached_strategy_report(name)
         s = r.get("sched")

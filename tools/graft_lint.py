@@ -4,17 +4,21 @@
     python -m tools.graft_lint --strategy all       # + HLO rules, every strategy
     python -m tools.graft_lint --strategy zero3,ep --mesh 2x4
     python -m tools.graft_lint --strategy all --format json
-    python -m tools.graft_lint --strategy all --check    # the CI gate
+    python -m tools.graft_lint --strategy all --shard-flow --check  # the CI gate
 
 Two passes share one findings model and one waiver file
 (``analysis/waivers.toml``):
 
 - **HLO pass** — every requested parallel strategy's train step is
   compiled on a fake CPU mesh (no accelerator anywhere) and the hazard
-  rule pack H001-H007 runs over its optimized HLO: missed async
+  rule pack H001-H013 runs over its optimized HLO: missed async
   overlap, inverse-collective resharding, unaccountable/hoistable
   loop collectives, bf16->f32 upcasts on the wire, donation misses,
-  host round-trips, deadlock-shaped permutes and axis leaks.  See
+  host round-trips, deadlock-shaped permutes and axis leaks, plus the
+  sharding-flow family (implicit reshards, partition-rule coverage,
+  saved-layout contracts).  ``--shard-flow`` additionally renders the
+  per-strategy flow table and runs the cross-program layout contracts
+  (serve KV-pool pair agreement).  See
   ``ddl25spring_tpu/analysis/rules.py`` for the pack.
 - **source pass** — AST rules S101-S103 over the installable package:
   env reads in traced-code modules, jit call sites without a donation
@@ -103,7 +107,35 @@ def _fmt_sched(r: dict) -> list[str]:
     return lines
 
 
-def _render_table(src_findings, hlo_reports, sched: bool = False) -> str:
+def _fmt_shard_flow(summary: dict) -> list[str]:
+    """The --shard-flow block for one strategy: entry-parameter layout
+    table + the per-collective source walk (analysis/shard_flow.py)."""
+    lines = []
+    entry = summary.get("entry_params") or []
+    sharded = [p for p in entry if p["sharding"] not in ("-", "replicated")]
+    lines.append(
+        f"  shard-flow: {len(entry)} entry param(s), "
+        f"{len(sharded)} sharded"
+    )
+    for p in entry:
+        lines.append(
+            f"    {p['arg']:<28} {p['sharding']:<12} "
+            f"({p['bytes']} B)"
+        )
+    for fl in summary.get("flows") or []:
+        srcs = ", ".join(
+            f"{s['arg']}[{s['sharding']}]" for s in fl["sources"]
+        ) or ("<loop-internal>" if fl["internal"] else "<constants>")
+        if fl.get("truncated"):
+            srcs += "  (walk truncated: sources are a lower bound)"
+        lines.append(f"    {fl['op']} {fl['kind']} <- {srcs}")
+    return lines
+
+
+def _render_table(
+    src_findings, hlo_reports, sched: bool = False,
+    shard_flow: dict | None = None,
+) -> str:
     from ddl25spring_tpu.analysis.engine import summarize
 
     blocks = []
@@ -130,7 +162,21 @@ def _render_table(src_findings, hlo_reports, sched: bool = False) -> str:
         blocks.append(head)
         if sched:
             blocks.extend(_fmt_sched(r))
+        if shard_flow and name in shard_flow.get("strategies", {}):
+            blocks.extend(
+                _fmt_shard_flow(shard_flow["strategies"][name])
+            )
         blocks.extend(_fmt_finding(f) for f in fs)
+    if shard_flow is not None:
+        by_rule = ", ".join(
+            f"{k}={v}" for k, v in sorted(shard_flow["by_rule"].items())
+        ) or "none"
+        blocks.append(
+            "shard-flow cross-program contracts: "
+            f"{len(shard_flow['findings'])} finding(s)  "
+            f"[H011-H013 totals: {by_rule}]"
+        )
+        blocks.extend(_fmt_finding(f) for f in shard_flow["findings"])
     return "\n".join(blocks)
 
 
@@ -160,6 +206,14 @@ def main(argv=None) -> int:
                          "(analysis/sched.py).  The H008-H010 rules run "
                          "regardless; this flag controls the report "
                          "detail.  On by default under --check")
+    ap.add_argument("--shard-flow", action="store_true",
+                    help="render the sharding-flow section per strategy "
+                         "(entry-parameter layouts + per-collective "
+                         "source walk) and run the cross-program layout "
+                         "contracts — serve prefill/decode KV-pool "
+                         "agreement, on top of the per-strategy "
+                         "H011-H013 the rule pass always runs "
+                         "(analysis/shard_flow.py)")
     ap.add_argument("--no-src", action="store_true",
                     help="skip the source (AST) pass")
     ap.add_argument("--waivers", default=None, metavar="TOML",
@@ -204,7 +258,11 @@ def main(argv=None) -> int:
         )
         mesh_sizes = parse_mesh_arg(args.mesh)
         for name in names:
-            r = engine.lint_strategy(name, mesh_sizes)
+            # --shard-flow's per-collective source walk needs the HLO
+            # text of the same compile the lint pass already paid for
+            r = engine.lint_strategy(
+                name, mesh_sizes, keep_hlo=args.shard_flow
+            )
             if args.waivers and "findings" in r:
                 # a custom waiver file overrides the default one the
                 # strategy report already resolved against: re-apply
@@ -247,16 +305,48 @@ def main(argv=None) -> int:
                         strategy=name, waivers=waivers,
                     )
 
+    shard_flow_doc = None
+    if args.shard_flow and hlo_reports:
+        from ddl25spring_tpu.analysis import shard_flow as sf
+
+        shard_flow_doc = sf.flow_report(hlo_reports, waivers=waivers)
+    elif args.shard_flow:
+        # a silent no-op would read as "layout contracts checked and
+        # passed" — say loudly that nothing ran
+        print("graft-lint: --shard-flow needs the HLO pass; pass "
+              "--strategy all (or a list) to run the sharding-flow "
+              "section — NOTHING was checked", file=sys.stderr)
+
     if args.format == "json":
+        # per-rule finding counts across every pass, so CI artifacts
+        # diff mechanically (mirrors perf_report --format json's
+        # verdicts-in-document shape)
+        by_rule: dict = {}
+        for f in src_findings or []:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        for r in hlo_reports.values():
+            for f in r.get("findings") or []:
+                by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        for f in (shard_flow_doc or {}).get("findings", []):
+            by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
         doc = {
             "record": "graft_lint",
             "source": [f.to_dict() for f in src_findings or []],
-            "strategies": hlo_reports,
+            "strategies": {
+                # keep_hlo text serves the flow walk above; megabytes of
+                # HLO never belong in a JSON artifact
+                name: {k: v for k, v in r.items() if k != "hlo_text"}
+                for name, r in hlo_reports.items()
+            },
+            "by_rule": by_rule,
         }
+        if shard_flow_doc is not None:
+            doc["shard_flow"] = shard_flow_doc
         print(json.dumps(doc, indent=1, default=str))
     else:
         print(_render_table(
-            src_findings, hlo_reports, sched=args.sched or args.check
+            src_findings, hlo_reports, sched=args.sched or args.check,
+            shard_flow=shard_flow_doc,
         ))
 
     if args.check:
@@ -281,6 +371,12 @@ def main(argv=None) -> int:
                     print(f"CHECK FAIL {name}: {f['rule']} {f.get('op')}: "
                           f"{f['message']}", file=sys.stderr)
                     bad += 1
+        for f in (shard_flow_doc or {}).get("findings", []):
+            if not f.get("waived"):
+                print(f"CHECK FAIL shard-flow {f.get('strategy')}: "
+                      f"{f['rule']} {f.get('op')}: {f['message']}",
+                      file=sys.stderr)
+                bad += 1
         if bad:
             print(f"\ngraft-lint: {bad} unwaived finding(s)/failure(s)",
                   file=sys.stderr)
