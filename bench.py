@@ -615,15 +615,23 @@ def main(argv=None) -> None:
     ap.add_argument("--serve-budget", type=float, default=None, metavar="S",
                     help="wall-clock bound on the ramp phase (default: "
                          "run to drain)")
-    ap.add_argument("--serve-model", default=None, choices=("tiny", "ref"),
+    ap.add_argument("--serve-model", default=None,
+                    choices=("tiny", "tiny-deep", "ref"),
                     help="model to serve (default: tiny under --smoke, "
-                         "else the reference LLaMA constants)")
+                         "else the reference LLaMA constants; tiny-deep "
+                         "= 6-layer tiny, the speculative-decoding "
+                         "smoke target whose 1-layer drafter is "
+                         "genuinely cheap)")
     ap.add_argument("--no-serve-ab", action="store_true",
                     help="skip the continuous-vs-static A/B phase")
     ap.add_argument("--no-serve-prefix-ab", action="store_true",
                     help="skip the cached-vs-cold prefix-cache A/B "
                          "phase (it also never runs with "
                          "DDL25_SERVE_PREFIX=0)")
+    ap.add_argument("--no-serve-spec-ab", action="store_true",
+                    help="skip the speculative spec-on-vs-off A/B "
+                         "phase (it also never runs without "
+                         "DDL25_SERVE_SPEC=1)")
     ap.add_argument("--compile-report", action="store_true",
                     help="force the pre-device compile report on CPU runs "
                          "(the accelerator path always computes it; see "
@@ -802,6 +810,7 @@ def main(argv=None) -> None:
             ledger_path=args.perf_ledger or "runs/perf_ledger.jsonl",
             skip_ab=args.no_serve_ab,
             skip_prefix_ab=args.no_serve_prefix_ab,
+            skip_spec_ab=args.no_serve_spec_ab,
         )
         telemetry: dict = {
             "enabled": bool(args.obs_dir),

@@ -49,14 +49,18 @@ COMPILE_REPORT_BASENAME = "compile_report.json"
 # table strategies (dp-rules / zero3-rules: the strategy is a mesh +
 # regex rule table + issue discipline, parallel/rules.py), pinned
 # bitwise-identical to their bespoke twins and coverage-proven by the
-# sharding-flow verifier (analysis/shard_flow.py, H011-H013).  All
-# nineteen share the tests' lower-once compile cache, so tier-1 pays
-# each compile exactly once.
+# sharding-flow verifier (analysis/shard_flow.py, H011-H013).  PR 13
+# adds the speculative-decoding pair (serve-draft / serve-verify: the
+# tiny-LLaMA drafter's k-token scan over its own paged pool and the
+# target's width-(k+1) verify pass, serve/spec.py).  All twenty-one
+# share the tests' lower-once compile cache, so tier-1 pays each
+# compile exactly once.
 DEFAULT_STRATEGIES = (
     "dp", "dp-overlap", "dp-rules", "zero1", "zero1-overlap", "zero2",
     "zero2-overlap", "zero3", "zero3-prefetch", "zero3-overlap",
     "zero3-rules", "pipeline", "het_pipeline", "tp", "sp", "ep",
     "serve-decode", "serve-prefill", "serve-prefill-cached",
+    "serve-draft", "serve-verify",
 )
 
 

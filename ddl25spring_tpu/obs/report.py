@@ -280,6 +280,7 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 "ramp": sdoc.get("ramp"),
                 "ab": sdoc.get("ab"),
                 "prefix_ab": sdoc.get("prefix_ab"),
+                "spec_ab": sdoc.get("spec_ab"),
                 "git_sha": sdoc.get("git_sha"),
             }
         except (json.JSONDecodeError, OSError) as e:
@@ -495,6 +496,30 @@ def format_report(summary: dict[str, Any]) -> str:
                     f"budget {pab.get('budget_s')} s  (advantage "
                     f"{pab.get('advantage_tokens')}, tokens match "
                     f"{pab.get('tokens_match')})"
+                )
+            spec = ramp.get("spec") or {}
+            if spec.get("enabled"):
+                acc = ramp.get("acceptance_rate")
+                lines.append(
+                    f"  speculative decode k={spec.get('k')} drafter "
+                    f"{spec.get('draft_layers')}L: acceptance "
+                    + (f"{acc * 100:.1f}%" if isinstance(
+                        acc, (int, float)) else "n/a")
+                    + f" ({ramp.get('draft_tokens_accepted')} acc / "
+                    f"{ramp.get('draft_tokens_rejected')} rej)  "
+                    f"rounds {spec.get('rounds')}  draft steps "
+                    f"{spec.get('draft_steps')}  verify steps "
+                    f"{spec.get('verify_steps')}"
+                )
+            sab = sv.get("spec_ab")
+            if sab:
+                lines.append(
+                    "  spec A/B spec "
+                    f"{sab.get('spec_tokens_at_budget')} vs non-spec "
+                    f"{sab.get('nospec_tokens_at_budget')} tokens at "
+                    f"budget {sab.get('budget_s')} s  (advantage "
+                    f"{sab.get('advantage_tokens')}, tokens match "
+                    f"{sab.get('tokens_match')})"
                 )
 
     c = summary.get("counters", {})

@@ -902,6 +902,22 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "axes": ("model",), "default_mesh": (2,),
         "kwargs": {"program": "prefill", "start": 4},
     },
+    # the speculative-decoding pair (PR 13, serve/spec.py): the tiny-
+    # LLaMA drafter's k-token proposal scan over its OWN paged pool and
+    # the target's single width-(k+1) verify pass — all-reduce-only
+    # signatures whose counts differ by exactly the draft/target depth
+    # ratio (the compile-time half of the virtual clock's FLOP-ratio
+    # pricing), pools head-dim-sharded under the same H013 contract
+    "serve-draft": {
+        "module": "ddl25spring_tpu.serve.spec",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "draft"},
+    },
+    "serve-verify": {
+        "module": "ddl25spring_tpu.serve.spec",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "verify"},
+    },
     # the partition-rule-engine variants (PR 12): the strategy is DATA —
     # a mesh shape + ordered regex rule table + issue discipline
     # (parallel/rules.py) — lowered through the generic RulePartitioner

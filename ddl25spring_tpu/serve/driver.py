@@ -73,13 +73,28 @@ def engine_knobs(smoke: bool = False) -> dict[str, Any]:
         # with no repeated prefixes simply never hits, and the cold
         # path is bitwise-identical; 0 disables outright
         "prefix_cache": bool(env_int("DDL25_SERVE_PREFIX", 1)),
+        # speculative decoding (PR 13): off by default — DDL25_SERVE_
+        # SPEC=1 enables the early-exit drafter with DDL25_SERVE_SPEC_K
+        # draft tokens per round and DDL25_SERVE_DRAFT_LAYERS drafter
+        # depth (greedy-only; the engine refuses spec with sampling).
+        # k=2 measured best on the smoke workload (see RESULTS PR-13)
+        "spec_k": (
+            env_int("DDL25_SERVE_SPEC_K", 2)
+            if env_int("DDL25_SERVE_SPEC", 0) else 0
+        ),
+        "draft_layers": env_int("DDL25_SERVE_DRAFT_LAYERS", 1),
     }
 
 
 def serve_model(model: str):
     """The model the bench serves: ``tiny`` (the CI smoke / test config
-    — fp32 so the paged-vs-dense pin is bitwise) or ``ref`` (the
-    reference LLaMA workload constants, bf16)."""
+    — fp32 so the paged-vs-dense pin is bitwise), ``tiny-deep`` (the
+    speculative smoke: same tiny dims at 6 layers, so the 1-layer
+    early-exit drafter is genuinely cheap — at 2 layers the drafter
+    costs ~0.56 of the target and speculation barely pays; at 6 it is
+    ~0.20 and the A/B margin is robust.  Depth rides the layer scan, so
+    the compile bill matches tiny's) or ``ref`` (the reference LLaMA
+    workload constants, bf16)."""
     from ddl25spring_tpu.utils.config import LlamaConfig
 
     if model == "tiny":
@@ -87,9 +102,16 @@ def serve_model(model: str):
             vocab_size=64, dmodel=16, num_heads=2, n_layers=2,
             ctx_size=32, dtype="float32",
         )
+    if model == "tiny-deep":
+        return LlamaConfig(
+            vocab_size=64, dmodel=16, num_heads=2, n_layers=6,
+            ctx_size=32, dtype="float32",
+        )
     if model == "ref":
         return LlamaConfig()
-    raise ValueError(f"model={model!r} is not 'tiny' or 'ref'")
+    raise ValueError(
+        f"model={model!r} is not 'tiny', 'tiny-deep' or 'ref'"
+    )
 
 
 def _build_engine(params, cfg, knobs: dict[str, Any], **over):
@@ -244,6 +266,88 @@ def prefix_ab_compare(
     return out
 
 
+def spec_ab_compare(
+    params, cfg, trace, knobs: dict[str, Any], *,
+    tick_s: float | None = None, max_steps: int = 20_000,
+    sentinel: bool | None = None,
+) -> dict[str, Any]:
+    """Speculative-decoding A/B (PR 13): the identical trace through a
+    SPEC engine (tiny-LLaMA drafter, k-token draft + one verify pass)
+    and a plain sequential-decode one, both continuous admission on the
+    virtual clock at the same ``prefill_batch = max_slots`` width —
+    equal admission budget, so the only difference is how many target
+    weight streams each committed token costs.  The virtual clock is
+    the judge because the 2-core CPU sandbox wall clock cannot be:
+    decode is memory-bandwidth-bound on a real chip (one verify pass =
+    one weight stream = 1 tick, vs k ticks of sequential decode), while
+    the CPU host is compute-bound and would charge the verify scan k+1
+    ticks of wall time.  The drafter is charged its FLOP ratio per
+    step and its full prefill scan — nothing rides free.
+
+    ``tokens_match`` is the correctness half: greedy speculation emits
+    the target's own argmax stream, so every request completed by BOTH
+    arms must carry the identical tokens (the full pin — accept-all,
+    reject-first, mid-draft rejection, EOS-inside-draft, page-boundary
+    drafts — lives in ``tests/test_serve_spec.py``)."""
+    if not knobs.get("spec_k"):
+        raise ValueError("spec_ab_compare needs knobs['spec_k'] > 0")
+    if tick_s is None:
+        tick_s = ab_tick_s(trace, knobs["max_slots"])
+    out: dict[str, Any] = {}
+    engines = {}
+    for arm, k in (("spec", knobs["spec_k"]), ("nospec", 0)):
+        e = _build_engine(
+            params, cfg, knobs, admission="continuous", clock="virtual",
+            tick_s=tick_s, temperature=0.0, sentinel=sentinel,
+            prefill_batch=knobs["max_slots"], spec_k=k,
+        )
+        m = e.run(trace, max_steps=max_steps)
+        engines[arm] = e
+        out[arm] = {
+            "drain_wall_s": m["wall_s"],
+            "ticks": m["ticks"],
+            "prefills": m["prefills"],
+            "generated_tokens": m["generated_tokens"],
+            "completed": m["completed"],
+            "rejected": m["rejected"],
+            "tokens_per_sec_per_chip": m["tokens_per_sec_per_chip"],
+            **({
+                "acceptance_rate": m["acceptance_rate"],
+                "draft_tokens_accepted": m["draft_tokens_accepted"],
+                "draft_tokens_rejected": m["draft_tokens_rejected"],
+                "spec": m["spec"],
+            } if k else {}),
+        }
+    budget = round(
+        (out["spec"]["drain_wall_s"] + out["nospec"]["drain_wall_s"]) / 2,
+        6,
+    )
+    spec_toks = engines["spec"].tokens_at(budget)
+    nospec_toks = engines["nospec"].tokens_at(budget)
+    streams = {
+        arm: {r.rid: list(r.tokens) for r in e.done}
+        for arm, e in engines.items()
+    }
+    common = set(streams["spec"]) & set(streams["nospec"])
+    out.update(
+        budget_s=budget,
+        tick_s=tick_s,
+        spec_tokens_at_budget=spec_toks,
+        nospec_tokens_at_budget=nospec_toks,
+        advantage_tokens=spec_toks - nospec_toks,
+        advantage_frac=(
+            round((spec_toks - nospec_toks) / nospec_toks, 4)
+            if nospec_toks else None
+        ),
+        tokens_match=all(
+            streams["spec"][rid] == streams["nospec"][rid]
+            for rid in common
+        ),
+        compared_requests=len(common),
+    )
+    return out
+
+
 def run_serve_bench(
     *,
     smoke: bool = False,
@@ -259,6 +363,7 @@ def run_serve_bench(
     sentinel: bool | None = None,
     skip_ab: bool = False,
     skip_prefix_ab: bool = False,
+    skip_spec_ab: bool = False,
 ) -> dict[str, Any]:
     """The whole serving bench; returns the BENCH record (one JSON line
     with ``telemetry.serve``).  ``budget_s`` bounds the wall-clock ramp
@@ -273,12 +378,24 @@ def run_serve_bench(
     from ddl25spring_tpu.serve.traffic import TrafficSpec, synth_trace
 
     t_start = time.perf_counter()
+    from ddl25spring_tpu.utils.config import env_int
+
     model = model or ("tiny" if smoke else "ref")
     cfg = serve_model(model)
     knobs = engine_knobs(smoke=smoke)
     traffic_defaults = SMOKE_TRAFFIC if smoke else {
         "duration_s": 30.0, "rate_rps": 8.0, "profile": "ramp", "seed": 0,
     }
+    profile = profile or traffic_defaults["profile"]
+    # decode-length jitter (PR 13): per-request max_new variation on
+    # the shared profile so the speculative A/B exercises variable
+    # lengths; 0 (the default) leaves every existing trace untouched.
+    # Zeroed off the shared profile — the knob has no effect there, and
+    # letting a no-op env var into the ledger key would orphan the
+    # run's trend group for nothing
+    jitter = (
+        env_int("DDL25_SERVE_JITTER", 0) if profile == "shared" else 0
+    )
     spec = TrafficSpec(
         seed=traffic_defaults["seed"] if seed is None else seed,
         duration_s=(
@@ -288,8 +405,9 @@ def run_serve_bench(
         rate_rps=(
             traffic_defaults["rate_rps"] if rate_rps is None else rate_rps
         ),
-        profile=profile or traffic_defaults["profile"],
+        profile=profile,
         vocab_size=cfg.vocab_size,
+        max_new_jitter=jitter,
     )
     trace = synth_trace(spec)
     flight.annotate(
@@ -327,6 +445,13 @@ def run_serve_bench(
             temperature=temperature, sentinel=sentinel,
         )
 
+    # --- spec-on-vs-off A/B: virtual clock, deterministic -------------
+    spec_ab = None
+    if not skip_spec_ab and knobs.get("spec_k"):
+        spec_ab = spec_ab_compare(
+            params, cfg, trace, knobs, sentinel=sentinel,
+        )
+
     record: dict[str, Any] = {
         "record": "serve",
         "ts": time.time(),
@@ -348,6 +473,15 @@ def run_serve_bench(
             # a prefix-cached engine is a different measurement than a
             # cold one (the whole point of the PR-11 A/B) — keyed apart
             "prefix_cache": bool(knobs.get("prefix_cache")),
+            # spec fields (and jitter) enter the key ONLY when on: a
+            # pre-PR-13 row's key string must not shift under it, or
+            # every existing trend group would silently orphan
+            **({
+                "spec": True,
+                "spec_k": knobs["spec_k"],
+                "draft_layers": knobs["draft_layers"],
+            } if knobs.get("spec_k") else {}),
+            **({"max_new_jitter": jitter} if jitter else {}),
             **({
                 "shared_prefixes": spec.shared_prefixes,
                 "shared_prefix_len": spec.shared_prefix_len,
@@ -358,6 +492,7 @@ def run_serve_bench(
         "ramp": ramp,
         **({"ab": ab} if ab is not None else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab is not None else {}),
+        **({"spec_ab": spec_ab} if spec_ab is not None else {}),
         # bounded raw samples for serve_report's histogram (the summary
         # percentiles above are what the gates read)
         "ttft_s": [round(x, 6) for x in eng.ttft_s[:512]],
@@ -411,6 +546,11 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
         "prefix_hit_rate": ramp.get("prefix_hit_rate"),
         "prefill_tokens_saved": ramp.get("prefill_tokens_saved"),
         "prefill_flops_saved": ramp.get("prefill_flops_saved"),
+        # speculative decoding's counters (None / 0 with spec off) —
+        # acceptance_rate is a GATED key on spec runs
+        "acceptance_rate": ramp.get("acceptance_rate"),
+        "draft_tokens_accepted": ramp.get("draft_tokens_accepted"),
+        "draft_tokens_rejected": ramp.get("draft_tokens_rejected"),
     }
     ab = record.get("ab")
     if ab:
@@ -425,6 +565,9 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
     pab = record.get("prefix_ab")
     if pab:
         out["prefix_ab"] = _prefix_ab_cell(pab)
+    sab = record.get("spec_ab")
+    if sab:
+        out["spec_ab"] = _spec_ab_cell(sab)
     return out
 
 
@@ -450,6 +593,32 @@ def _prefix_ab_cell(pab: dict[str, Any]) -> dict[str, Any]:
         "prefix_hit_rate": cached.get("prefix_hit_rate"),
         "prefill_tokens_saved": cached.get("prefill_tokens_saved"),
         "prefill_flops_saved": cached.get("prefill_flops_saved"),
+    }
+
+
+def _spec_ab_cell(sab: dict[str, Any]) -> dict[str, Any]:
+    """The speculative A/B summary both the ledger row and
+    telemetry.serve carry — what ``serve_report --check-spec-ab``
+    gates."""
+    spec_arm = sab.get("spec") or {}
+    nospec_arm = sab.get("nospec") or {}
+    return {
+        "budget_s": sab.get("budget_s"),
+        "spec_tokens_at_budget": sab.get("spec_tokens_at_budget"),
+        "nospec_tokens_at_budget": sab.get("nospec_tokens_at_budget"),
+        "advantage_tokens": sab.get("advantage_tokens"),
+        "advantage_frac": sab.get("advantage_frac"),
+        "tokens_match": sab.get("tokens_match"),
+        "compared_requests": sab.get("compared_requests"),
+        "spec_tokens_per_sec_per_chip": spec_arm.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "nospec_tokens_per_sec_per_chip": nospec_arm.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "acceptance_rate": spec_arm.get("acceptance_rate"),
+        "draft_tokens_accepted": spec_arm.get("draft_tokens_accepted"),
+        "draft_tokens_rejected": spec_arm.get("draft_tokens_rejected"),
     }
 
 
@@ -480,6 +649,10 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
         "prefill_tokens_saved": ramp.get("prefill_tokens_saved"),
         "prefill_flops_saved": ramp.get("prefill_flops_saved"),
         "prefix": ramp.get("prefix"),
+        "acceptance_rate": ramp.get("acceptance_rate"),
+        "draft_tokens_accepted": ramp.get("draft_tokens_accepted"),
+        "draft_tokens_rejected": ramp.get("draft_tokens_rejected"),
+        "spec": ramp.get("spec"),
     }
     ab = record.get("ab")
     if ab:
@@ -495,6 +668,9 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
     pab = record.get("prefix_ab")
     if pab:
         cell["prefix_ab"] = _prefix_ab_cell(pab)
+    sab = record.get("spec_ab")
+    if sab:
+        cell["spec_ab"] = _spec_ab_cell(sab)
     for k in ("ledger", "ledger_error", "serve_json"):
         if record.get(k):
             cell[k] = record[k]

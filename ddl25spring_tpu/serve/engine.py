@@ -355,6 +355,13 @@ def _release(pool, mask):
 _adopt = jax.jit(kv_pages.adopt_prefix)
 _unref = jax.jit(kv_pages.unref_pages)
 _ref = jax.jit(kv_pages.ref_pages)
+# speculative rollback (PR 13): shared across both pools (the wrapper
+# respecializes per pool geometry).  Like release, it deliberately does
+# NOT donate — see the donation note in _compiled_programs; truncate
+# runs twice per spec round, but aliasing the pool through an auxiliary
+# program was measured to slow every subsequent tick/prefill ~5x on
+# the CPU backend, and the un-donated copy is the cheap side.
+_truncate = jax.jit(kv_pages.truncate_to)
 
 
 # One compiled (tick, prefill, release) triple per build key: the ramp
@@ -425,6 +432,41 @@ def _prefill_variant(
     return _PREFILL_CACHE[key]
 
 
+# speculative-decoding programs (PR 13): one compiled (draft-k,
+# draft-k+1, verify) triple per (target cfg, draft cfg, k, sentinel,
+# donate) — every same-config engine (the spec A/B's two arms, the
+# test engines) shares the XLA programs.  The drafter's prefill rides
+# _PREFILL_CACHE (keyed by the DRAFT cfg, start 0), and rollback rides
+# the module-level _truncate wrapper.
+_SPEC_CACHE: dict[tuple, dict] = {}
+
+
+def _spec_programs(
+    cfg: LlamaConfig, draft_cfg: LlamaConfig, *, k: int,
+    sentinel: bool | None, donate: bool,
+):
+    from ddl25spring_tpu.serve import spec as spec_mod
+
+    key = (cfg, draft_cfg, k, sentinels.resolve(sentinel), donate)
+    if key not in _SPEC_CACHE:
+        pool_kw = {"donate_argnums": (1,)} if donate else {}
+        _SPEC_CACHE[key] = {
+            # steps=k serves rounds where every slot owes exactly one
+            # catch-up token (the common case); steps=k+1 is the
+            # post-full-accept variant — both pre-compiled by warmup()
+            "draft_k": jax.jit(spec_mod.make_draft(
+                draft_cfg, k=k, steps=k, sentinel=sentinel,
+            ), **pool_kw),
+            "draft_k1": jax.jit(spec_mod.make_draft(
+                draft_cfg, k=k, steps=k + 1, sentinel=sentinel,
+            ), **pool_kw),
+            "verify": jax.jit(spec_mod.make_verify(
+                cfg, k=k, sentinel=sentinel,
+            ), **pool_kw),
+        }
+    return _SPEC_CACHE[key]
+
+
 # ----------------------------------------------------------- host engine
 
 
@@ -486,6 +528,10 @@ class ServeEngine:
         tick_s: float = 1e-3,
         seed: int = 0,
         prefix_cache: bool = False,
+        spec_k: int = 0,
+        draft_layers: int = 1,
+        draft_params: Params | None = None,
+        draft_cfg: LlamaConfig | None = None,
     ):
         if admission not in ("continuous", "static"):
             raise ValueError(
@@ -498,6 +544,17 @@ class ServeEngine:
             # never advances — the run() loop would spin to max_steps
             raise ValueError(
                 f"prefill_batch={prefill_batch} must be >= 1"
+            )
+        if spec_k < 0:
+            raise ValueError(f"spec_k={spec_k} must be >= 0 (0 = off)")
+        if spec_k and temperature != 0.0:
+            # greedy speculation is exactly the target's own output (a
+            # draft is accepted iff it equals the argmax); sampled
+            # speculation needs the rejection-sampling correction —
+            # future work, refuse rather than serve a skewed stream
+            raise ValueError(
+                "speculative decoding is greedy-only "
+                f"(temperature={temperature} with spec_k={spec_k})"
             )
         self.cfg = cfg
         self.params = params
@@ -536,6 +593,54 @@ class ServeEngine:
         self.prefix: PrefixCache | None = (
             PrefixCache(page_len) if prefix_cache else None
         )
+        # speculative decoding (opt-in, PR 13): a tiny drafter with its
+        # OWN paged pool proposes spec_k tokens per round; one target
+        # verify pass scores them all; truncate_to rolls both pools
+        # back to the accepted prefix.  The default drafter is the
+        # early-exit construction (serve/spec.py) — pass draft_params +
+        # draft_cfg for a distilled one.
+        self.spec_k = int(spec_k)
+        self.draft_pool: dict | None = None
+        if self.spec_k:
+            from ddl25spring_tpu.serve import spec as spec_mod
+
+            if draft_params is None:
+                draft_params, draft_cfg = spec_mod.early_exit_drafter(
+                    params, cfg, draft_layers
+                )
+            elif draft_cfg is None:
+                raise ValueError(
+                    "explicit draft_params need their draft_cfg"
+                )
+            self.draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            # what each drafter step costs on the deterministic virtual
+            # clock, as a fraction of a target decode tick
+            self.spec_flop_ratio = spec_mod.flop_ratio(draft_params, params)
+            # the drafter pool mirrors the target pool's geometry and
+            # shares NOTHING (no prefix cache claims drafter pages), so
+            # spec-mode admission bills every request its FULL worst
+            # case (no prefix discount — see _admittable) and both
+            # pools are covered by the one bill; drafter writes are
+            # bounded by the same per-row limits the verify honors
+            self.draft_pool = kv_pages.init_page_pool(
+                draft_cfg, n_pages=n_pages, page_len=page_len,
+                max_slots=max_slots, pages_per_seq=self.pages_per_seq,
+            )
+            progs = _spec_programs(
+                cfg, draft_cfg, k=self.spec_k, sentinel=sentinel,
+                donate=donate,
+            )
+            self._draft_k = progs["draft_k"]
+            self._draft_k1 = progs["draft_k1"]
+            self._verify = progs["verify"]
+            self._draft_prefill = _prefill_variant(
+                draft_cfg, max_prompt_len=max_prompt_len, start=0,
+                temperature=0.0, sentinel=sentinel, donate=donate,
+            )
+            # greedy programs never consume randomness; the drafter
+            # prefill still takes a key positionally
+            self._zero_key = jax.random.PRNGKey(0)
         # analytic forward cost of one prompt token (the standard
         # 2·N_params estimate) — prices prefill_flops_saved
         self._flops_per_token = 2 * sum(
@@ -558,10 +663,16 @@ class ServeEngine:
         # re-bucket the exact device-used mirror
         self._adopted_pages: list[list[int]] = [[] for _ in range(max_slots)]
         self._cached_pages: list[list[int]] = [[] for _ in range(max_slots)]
+        # spec: committed tokens the drafter has not appended yet (the
+        # last committed token; plus, after a fully-accepted round, the
+        # final draft it sampled but never wrote) — at most 2
+        self._pending: list[list[int]] = [[] for _ in range(max_slots)]
         self._t0 = time.perf_counter()
         self._vtime = 0.0
         self._ticks = 0
         self._prefills = 0
+        self._spec_rounds = 0
+        self._draft_steps = 0  # drafter scan steps actually charged
         self._next_rid = 0
         # telemetry
         self.admitted = 0
@@ -574,6 +685,14 @@ class ServeEngine:
         # not run through the model; FLOPs priced at 2·N_params/token)
         self.prefill_tokens_saved = 0
         self.prefill_flops_saved = 0
+        # speculative counters: proposals = spec_k per live slot per
+        # round; accepted = draft-origin tokens actually EMITTED
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
+        # accepted-prefix length -> round count (k+2 keys at most) —
+        # the accept histogram serve.json renders; coverage of 0 /
+        # mid / k is what the bitwise pins assert they exercised
+        self.spec_accept_counts: dict[int, int] = {}
         self.queue_depths: list[int] = []
         self.ttft_s: list[float] = []
         self.tick_wall_s: list[float] = []
@@ -633,6 +752,30 @@ class ServeEngine:
         self._pending_pages = [0] * self.max_slots
         self._adopted_pages = [[] for _ in range(self.max_slots)]
         self._cached_pages = [[] for _ in range(self.max_slots)]
+        self._pending = [[] for _ in range(self.max_slots)]
+        if self.spec_k:
+            # the probe round compiled the drafter prefill, the common
+            # k-step draft variant, verify, and both pools' truncate;
+            # the (k+1)-step catch-up variant only runs after a fully-
+            # accepted round — warm it on a scratch pool (all-padding
+            # args: active is all False, nothing mutates) so the first
+            # full accept mid-run never pays XLA on the wall clock
+            scratch = kv_pages.init_page_pool(
+                self.draft_cfg, n_pages=self.n_pages,
+                page_len=self.page_len, max_slots=self.max_slots,
+                pages_per_seq=self.pages_per_seq,
+            )
+            self._draft_k1(
+                self.draft_params, scratch,
+                jnp.zeros((self.max_slots, 2), jnp.int32),
+                jnp.zeros((self.max_slots,), jnp.int32),
+                jnp.zeros((self.max_slots,), jnp.int32),
+            )
+            self.draft_pool = kv_pages.init_page_pool(
+                self.draft_cfg, n_pages=self.n_pages,
+                page_len=self.page_len, max_slots=self.max_slots,
+                pages_per_seq=self.pages_per_seq,
+            )
         if self.prefix is not None:  # drop the probe's cached prompt
             self.prefix = PrefixCache(self.page_len)
             # compile the sharing ops at the exact shapes the engine
@@ -662,6 +805,9 @@ class ServeEngine:
             )
         self._vtime = 0.0
         self._ticks = self._prefills = 0
+        self._spec_rounds = self._draft_steps = 0
+        self.draft_tokens_proposed = self.draft_tokens_accepted = 0
+        self.spec_accept_counts = {}
         self.admitted = self.completed = self.generated_tokens = 0
         self.rejected = {}
         self.pool_ok_failures = 0
@@ -842,7 +988,19 @@ class ServeEngine:
             m = self._match(self.queue[0])
             if out and self._scan_start(m) != self._scan_start(out[0][2]):
                 break  # next batch: different static start offset
-            need = self._pages_needed(self.queue[0]) - m.n_ref
+            # with speculation on, the prefix discount is forfeit at
+            # the ADMISSION bill (the adoption itself — and the prefill
+            # compute it saves — still happens): the drafter pool has
+            # the same n_pages but shares nothing, so a slot costs it
+            # the FULL worst case; billing the target's discounted need
+            # would admit loads the drafter pool cannot hold (observed:
+            # drafter reserve_pages exhaustion under a tight pool with
+            # repeated prompts).  Since the target's true commitment is
+            # <= the full bill + the cache's held pages, one
+            # conservative bill covers both pools.
+            need = self._pages_needed(self.queue[0]) - (
+                0 if self.spec_k else m.n_ref
+            )
             if need > budget:
                 if self.prefix is None:
                     break  # head-of-line blocks until pages free
@@ -955,6 +1113,21 @@ class ServeEngine:
         first = jax.device_get(first)
         if not bool(ok):
             self.pool_ok_failures += 1
+        if self.spec_k:
+            # the drafter prefills its OWN pool over the same batch —
+            # always the full prompt scan (the radix cache shares
+            # target pages only, so a matched prefix saves no drafter
+            # work); its sampled token is discarded (the target's
+            # `first` is the committed stream).  Greedy: the key is
+            # never consumed, so the engine's key stream — and with it
+            # the spec-off bitwise twin — is untouched.
+            self.draft_pool, _draft_first, ok_d = self._draft_prefill(
+                self.draft_params, self.draft_pool, jnp.asarray(prompts),
+                jnp.asarray(lens), jnp.zeros((B,), jnp.int32),
+                jnp.asarray(slot_ids), self._zero_key,
+            )
+            if not bool(ok_d):
+                self.pool_ok_failures += 1
         wall = time.perf_counter() - t0
         self._prefills += 1
         # the virtual clock charges prefill for the scan it actually
@@ -965,13 +1138,20 @@ class ServeEngine:
             self.tick_s * (self.max_prompt_len - start)
             / self.max_prompt_len
         )
+        if self.spec_k:
+            # the drafter's full-prompt scan, at its FLOP ratio
+            self._advance(self.tick_s * self.spec_flop_ratio)
         now = self.now()
         for row, (slot, req, m) in enumerate(batch):
             req.admitted_t = now
             self.slots[slot] = req
             self._adopted_pages[slot] = list(m.pages)
             self._cached_pages[slot] = []
-            self._reserved[slot] = self._pages_needed(req) - m.n_ref
+            # mirror of the admission bill: full worst case under spec
+            # (the drafter pool's share-less need), discounted otherwise
+            self._reserved[slot] = self._pages_needed(req) - (
+                0 if self.spec_k else m.n_ref
+            )
             self.admitted += 1
             if self.prefix is not None:
                 self.prefix.lookups += 1
@@ -983,6 +1163,10 @@ class ServeEngine:
             # is replayed, so billing it as saved would overcount
             self.prefill_tokens_saved += start
             self.prefill_flops_saved += start * self._flops_per_token
+            # the drafter owes this first committed token its KV; a
+            # request that completes at this very token is released by
+            # the flush, which clears the pending list with the slot
+            self._pending[slot] = [int(first[row])]
             self._emit_token(slot, req, int(first[row]), now)
             req.first_token_t = now
             self.ttft_s.append(now - req.arrival_t)
@@ -1049,6 +1233,140 @@ class ServeEngine:
                 pages_used=self._host_pages_used(),
             )
 
+    def _run_spec_round(self) -> None:
+        """One speculative round over every active slot: the drafter
+        proposes ``spec_k`` tokens (its own pool), ONE target verify
+        pass scores all ``spec_k + 1`` positions, the accepted prefix
+        commits — each accepted draft equals the target argmax, the
+        first rejection is replaced by it, a full accept earns the
+        bonus token — and both pools roll back to the committed
+        frontier (``kv_pages.truncate_to``).  Greedy acceptance makes
+        the emitted stream BITWISE the sequential engine's; the
+        deterministic virtual clock charges 1 tick for the verify pass
+        (one target weight stream) plus ``flop_ratio`` per drafter
+        step, which is the whole speculative win."""
+        from ddl25spring_tpu.obs import flight
+
+        k = self.spec_k
+        S = self.max_slots
+        ctx = np.zeros((S, 2), np.int32)
+        n_ctx = np.zeros((S,), np.int32)
+        limits = np.zeros((S,), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            pend = self._pending[slot]
+            assert 1 <= len(pend) <= 2, (slot, pend)
+            ctx[slot, : len(pend)] = pend
+            n_ctx[slot] = len(pend)
+            # the last position a non-speculative decode would write
+            # for this request — verify writes past it trash-route, so
+            # speculation stays inside the admission-billed worst case
+            limits[slot] = req.prompt_len + req.max_new_tokens - 1
+        # the (k+1)-step draft variant only exists for 2-token catch-up
+        # rounds (the round after a full accept); every other round
+        # rides the cheaper k-step program — and the clock bills the
+        # steps the chosen program actually ran
+        steps = k + 1 if int(n_ctx.max(initial=0)) > 1 else k
+        draft_fn = self._draft_k1 if steps == k + 1 else self._draft_k
+
+        jlim = jnp.asarray(limits)
+        t0 = time.perf_counter()
+        self.draft_pool, drafts_dev, ok_d = draft_fn(
+            self.draft_params, self.draft_pool,
+            jnp.asarray(ctx), jnp.asarray(n_ctx), jlim,
+        )
+        # assemble the verify window ON DEVICE: draft and verify queue
+        # back to back with no host sync in between (one device_get of
+        # the small draft/greedy arrays after both dispatched)
+        toks = jnp.concatenate(
+            [jnp.asarray(np.asarray(self._slot_last_tok, np.int32)
+                         )[:, None], drafts_dev],
+            axis=1,
+        )
+        self.pool, greedy_dev, ok_v = self._verify(
+            self.params, self.pool, toks, jlim,
+        )
+        drafts = np.asarray(jax.device_get(drafts_dev))  # [S, k]
+        greedy = np.asarray(jax.device_get(greedy_dev))  # [S, k+1]
+        wall = time.perf_counter() - t0
+        if not bool(ok_d):
+            self.pool_ok_failures += 1
+        if not bool(ok_v):
+            self.pool_ok_failures += 1
+
+        self.tick_wall_s.append(wall)
+        self._spec_rounds += 1
+        # a spec round IS the engine's decode-family pass: count it as
+        # a tick (one target weight stream serving up to k+1 tokens) so
+        # `ticks` and the virtual-clock per-pass latency stay defined on
+        # speculative engines; the wall sample above likewise covers
+        # the whole round — more tokens per sample, same pass
+        self._ticks += 1
+        self._draft_steps += steps
+        self._advance(
+            self.tick_s * (1.0 + steps * self.spec_flop_ratio)
+        )
+        now = self.now()
+
+        new_lens = np.zeros((S,), np.int32)
+        mask = np.zeros((S,), bool)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            mask[slot] = True
+            self.draft_tokens_proposed += k
+            # accepted prefix: draft i is the target's own choice iff
+            # it equals greedy[i] (the argmax after consuming the
+            # previous position)
+            a = 0
+            while a < k and drafts[slot, a] == greedy[slot, a]:
+                a += 1
+            self.spec_accept_counts[a] = (
+                self.spec_accept_counts.get(a, 0) + 1
+            )
+            # committed token t0 sits at position p0; the round's
+            # emissions extend the written frontier one position each
+            p0 = req.prompt_len + len(req.tokens) - 1
+            emitted = 0
+            for j in range(a + 1):
+                self._emit_token(slot, req, int(greedy[slot, j]), now)
+                emitted += 1
+                if self.slots[slot] is None:
+                    break  # max_new / EOS — inside the draft window
+            # the first min(a, emitted) emissions are draft-origin
+            self.draft_tokens_accepted += min(a, emitted)
+            new_lens[slot] = p0 + emitted
+            if self.slots[slot] is not None:
+                if emitted == k + 1:
+                    # full accept: the drafter never appended its own
+                    # final draft, and the bonus token is new to it
+                    self._pending[slot] = [
+                        int(drafts[slot, k - 1]), int(greedy[slot, k]),
+                    ]
+                else:
+                    self._pending[slot] = [int(greedy[slot, emitted - 1])]
+        # roll BOTH pools back to the committed frontier: rejected
+        # positions' fresh pages return to the free set (refcount
+        # decrement — the same discipline as release), stale values
+        # inside kept pages are overwritten before they become readable
+        jl = jnp.asarray(new_lens)
+        jm = jnp.asarray(mask)
+        self.pool = _truncate(self.pool, jl, jm)
+        self.draft_pool = _truncate(self.draft_pool, jl, jm)
+        self._track_pages()
+        if self._spec_rounds % 8 == 0 or self._spec_rounds <= 2:
+            flight.record(
+                kind="serve_spec", step=self._spec_rounds,
+                wall_s=round(wall, 6),
+                active=int(mask.sum()),
+                draft_steps=steps,
+                accepted=self.draft_tokens_accepted,
+                proposed=self.draft_tokens_proposed,
+                queue=len(self.queue),
+                pages_used=self._host_pages_used(),
+            )
+
     def _slot_fresh_pages(self, slot: int, written: int) -> int:
         """Pages slot ``slot`` holds EXCLUSIVELY after writing
         ``written`` positions: its table entries so far, minus the
@@ -1086,13 +1404,17 @@ class ServeEngine:
     def _flush_releases(self) -> None:
         if not any(self._release_mask):
             return
-        self.pool = self._release(
-            self.pool, jnp.asarray(np.asarray(self._release_mask))
-        )
+        mask = jnp.asarray(np.asarray(self._release_mask))
+        self.pool = self._release(self.pool, mask)
+        if self.spec_k:
+            # the drafter's mirror slot returns its pages in the same
+            # flush (the jitted wrapper respecializes per pool shapes)
+            self.draft_pool = self._release(self.draft_pool, mask)
         for slot, flushed in enumerate(self._release_mask):
             if flushed:  # the slot stops pinning its shared pages
                 self._adopted_pages[slot] = []
                 self._cached_pages[slot] = []
+                self._pending[slot] = []
         self._release_mask = [False] * self.max_slots
         self._pending_pages = [0] * self.max_slots
 
@@ -1114,7 +1436,10 @@ class ServeEngine:
         # accounting and the host peak mirror never see
         self._flush_releases()
         if any(r is not None for r in self.slots):
-            self._run_decode_tick()
+            if self.spec_k:
+                self._run_spec_round()
+            else:
+                self._run_decode_tick()
             ran = True
         self.token_log.append((self.now(), self.generated_tokens))
         return ran
@@ -1240,6 +1565,36 @@ class ServeEngine:
                 self.prefix.stats() if self.prefix is not None
                 else {"enabled": False}
             ),
+            # speculative decoding: the deterministic counters the
+            # spec-on-vs-off A/B and serve_report --check-spec-ab read
+            "acceptance_rate": (
+                round(
+                    self.draft_tokens_accepted
+                    / self.draft_tokens_proposed, 4
+                ) if self.draft_tokens_proposed else None
+            ),
+            "draft_tokens_accepted": self.draft_tokens_accepted,
+            "draft_tokens_rejected": (
+                self.draft_tokens_proposed - self.draft_tokens_accepted
+            ),
+            "spec": (
+                {
+                    "enabled": True,
+                    "k": self.spec_k,
+                    "draft_layers": self.draft_cfg.n_layers,
+                    "draft_dim": self.draft_cfg.dmodel,
+                    "flop_ratio": round(self.spec_flop_ratio, 4),
+                    "rounds": self._spec_rounds,
+                    "draft_steps": self._draft_steps,
+                    "verify_steps": self._spec_rounds,
+                    "draft_tokens_proposed": self.draft_tokens_proposed,
+                    "draft_tokens_accepted": self.draft_tokens_accepted,
+                    "accept_counts": {
+                        str(a): n for a, n in
+                        sorted(self.spec_accept_counts.items())
+                    },
+                } if self.spec_k else {"enabled": False}
+            ),
             "config": {
                 "page_len": self.page_len,
                 "pages_per_seq": self.pages_per_seq,
@@ -1250,6 +1605,7 @@ class ServeEngine:
                 "token_budget": self.token_budget,
                 "clock": self.clock,
                 "prefix_cache": self.prefix is not None,
+                "spec_k": self.spec_k,
             },
         }
 
@@ -1279,6 +1635,7 @@ def make_tp_serve_program(
     model_axis: str = "model",
     temperature: float = 0.0,
     sentinel: bool | None = False,
+    spec_k: int = 2,
 ):
     """The TP-sharded serving program: ``(fn, pool, pool_specs)``.
 
@@ -1289,14 +1646,21 @@ def make_tp_serve_program(
     shard caches its local ``H/t`` heads), and the per-token
     communication is exactly the two row-parallel psums per block.
     ``pool`` is the freshly-initialized GLOBAL pool placed on the mesh;
-    thread it through calls like the single-device engine does."""
+    thread it through calls like the single-device engine does.
+
+    ``program`` may also be the speculative pair (PR 13): ``"draft"``
+    (pass the DRAFT cfg — the pool is built from it) or ``"verify"``,
+    both shaped by ``spec_k``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ddl25spring_tpu.parallel.tp import tp_param_specs
     from ddl25spring_tpu.utils.compat import pcast, shard_map
 
-    if program not in ("decode", "prefill"):
-        raise ValueError(f"program={program!r} is not 'decode'/'prefill'")
+    if program not in ("decode", "prefill", "draft", "verify"):
+        raise ValueError(
+            f"program={program!r} is not one of "
+            "'decode'/'prefill'/'draft'/'verify'"
+        )
     t = int(mesh.shape[model_axis])
     if cfg.num_heads % t:
         raise ValueError(f"{cfg.num_heads} heads not divisible by t={t}")
@@ -1327,12 +1691,28 @@ def make_tp_serve_program(
             sentinel=sentinel,
         )
         in_specs = (p_specs, pool_specs, P(), P())
-    else:
+    elif program == "prefill":
         body = make_prefill(
             cfg, max_prompt_len=max_prompt_len, start=start,
             temperature=temperature, tp_axis=tp_axis, sentinel=sentinel,
         )
         in_specs = (p_specs, pool_specs, P(), P(), P(), P(), P())
+    else:
+        # the speculative pair rides the same sharded pool contract;
+        # late import — spec.py needs this module's block body
+        from ddl25spring_tpu.serve import spec as spec_mod
+
+        if program == "draft":
+            body = spec_mod.make_draft(
+                cfg, k=spec_k, steps=spec_k + 1, tp_axis=tp_axis,
+                sentinel=sentinel,
+            )
+            in_specs = (p_specs, pool_specs, P(), P(), P())
+        else:
+            body = spec_mod.make_verify(
+                cfg, k=spec_k, tp_axis=tp_axis, sentinel=sentinel,
+            )
+            in_specs = (p_specs, pool_specs, P(), P())
 
     def wrapped(params, pool, *rest):
         if tp_axis is not None:

@@ -49,6 +49,13 @@ through two new jit-safe ops the radix prefix cache
   references (a cached page survives its owning sequence's completion;
   LRU eviction is an unref, and frees the page only at refcount 0).
 
+**Rollback (PR 13)**: :func:`truncate_to` rolls a slot's KV frontier
+back to an accepted prefix — speculative decoding's rejection path.
+Table entries past the new frontier drop one reference each (the same
+decrement discipline as :func:`release_slots`, so shared pages survive)
+and ``seq_len`` clamps; stale values inside the kept frontier page are
+overwritten before the monotone write frontier makes them readable.
+
 The pool invariant under ANY allocate/adopt/COW/release/unref
 interleaving — ``used + free == n_pages``, ``free == (refcount == 0)``,
 no double-free, no leak, the COW copy reachable from exactly one page
@@ -73,7 +80,7 @@ __all__ = [
     "resolve_heads", "init_page_pool", "pool_geometry", "reserve_pages",
     "write_page_ids", "append_layer_kv",
     "release_slots", "activate_slots", "used_pages",
-    "adopt_prefix", "ref_pages", "unref_pages",
+    "adopt_prefix", "ref_pages", "unref_pages", "truncate_to",
 ]
 
 
@@ -300,6 +307,53 @@ def adopt_prefix(pool: Pool, slots: jax.Array, adopt_pages: jax.Array,
         **pool, "k": k, "v": v, "free": refcount == 0,
         "refcount": refcount, "page_table": table,
     }, ok
+
+
+def truncate_to(pool: Pool, new_lens: jax.Array, mask: jax.Array) -> Pool:
+    """Roll back each masked slot's KV frontier to ``new_lens[slot]``
+    written positions — speculative decoding's rejection path (PR 13):
+    a verify pass writes the whole draft window optimistically, then the
+    first rejection truncates the sequence back to its accepted prefix.
+
+    Per masked slot: table entries whose pages start AT or PAST the new
+    frontier (``entry * page_len >= new_len``) are dropped — one
+    refcount decrement each, the page returning to the free set only at
+    count 0 (a shared page survives, exactly like :func:`release_slots`)
+    — and ``seq_len`` clamps to ``min(seq_len, new_len)``.  The page
+    holding the frontier is KEPT even when partially rolled back: its
+    tail positions hold stale k/v values, which is safe because every
+    read masks ``position <= pos`` and the write frontier is monotone —
+    a stale slot is overwritten (same step it next becomes readable)
+    before any attention can gather it.  Masked scatters with the usual
+    out-of-range sentinel: no ``lax.cond`` anywhere, jit/scan-safe.
+
+    A ``new_len`` at or above a slot's current frontier is a no-op for
+    that slot (the drafter pool rides the same call as the target pool
+    with the target's rollback length; on a fully-accepted round the
+    drafter has nothing to drop)."""
+    n_pages = pool["free"].shape[0]
+    P = pool["page_table"].shape[1]
+    page_len = pool["k"].shape[2]
+    mask = mask.astype(bool)
+    new_lens = jnp.maximum(new_lens, 0)
+
+    rows = pool["page_table"]
+    entry_start = (
+        jnp.arange(P, dtype=jnp.int32)[None, :] * page_len
+    )  # [1, P]
+    drop = mask[:, None] & (entry_start >= new_lens[:, None]) & (rows >= 0)
+    refcount = pool["refcount"].at[
+        jnp.where(drop, jnp.clip(rows, 0, n_pages - 1), n_pages)
+    ].add(-1, mode="drop")
+    refcount = jnp.maximum(refcount, 0)
+    table = jnp.where(drop, jnp.int32(-1), rows)
+    seq_len = jnp.where(
+        mask, jnp.minimum(pool["seq_len"], new_lens), pool["seq_len"]
+    )
+    return {
+        **pool, "free": refcount == 0, "refcount": refcount,
+        "page_table": table, "seq_len": seq_len,
+    }
 
 
 def ref_pages(pool: Pool, pages: jax.Array) -> Pool:

@@ -24,7 +24,9 @@ compare different workloads.
   seeded "system prompts" of ``shared_prefix_len`` tokens are drawn
   ONCE; every arrival picks one uniformly and appends its own
   ``shared_suffix_len`` random tokens (``max_new`` still drawn from the
-  mix's categorical).  Prompt length is therefore UNIFORM —
+  mix's categorical, optionally jittered per request by
+  ``max_new_jitter`` — the PR-13 knob that gives the speculative A/B
+  variable decode lengths).  Prompt length is therefore UNIFORM —
   page-granular radix matches land at one matched length, so the
   engine's start-homogeneous prefill batches never fragment — and at
   production-shaped traffic most arrivals repeat a recent prefix: the
@@ -72,6 +74,13 @@ class TrafficSpec:
     shared_prefixes: int = 2
     shared_prefix_len: int = 6
     shared_suffix_len: int = 2
+    # shared-profile decode-length jitter (PR 13): each arrival's
+    # max_new moves by a seeded uniform draw in [-j, +j] (floored at 1)
+    # so the speculative A/B exercises VARIABLE decode lengths — a
+    # homogeneous length would let every slot complete on the same
+    # round and hide the mid-flight accept/reject interleavings.  0
+    # draws nothing, so existing seeds replay byte-identically.
+    max_new_jitter: int = 0
 
     def rate_at(self, t: float) -> float:
         """Instantaneous arrival rate lambda(t) of the profile."""
@@ -100,6 +109,14 @@ def synth_trace(spec: TrafficSpec) -> list[dict[str, Any]]:
     weights = np.asarray([w for _, _, w in spec.mix], np.float64)
     weights = weights / weights.sum()
     shared = spec.profile == "shared"
+    # the jitter knob draws from its OWN seeded stream: arrivals,
+    # prompts and thinning are byte-identical across jitter settings
+    # (only max_new moves), so a jittered trace stays comparable to
+    # its jitter=0 twin — and jitter=0 replays the pre-knob bytes
+    jrng = (
+        np.random.RandomState(spec.seed ^ 0x5BD1E995)
+        if shared and spec.max_new_jitter > 0 else None
+    )
     prefixes: list[list[int]] = []
     if shared:
         if spec.shared_prefixes < 1 or spec.shared_prefix_len < 1:
@@ -132,6 +149,10 @@ def synth_trace(spec: TrafficSpec) -> list[dict[str, Any]]:
         if shared:
             _, max_new, _ = spec.mix[int(rng.choice(len(spec.mix),
                                                     p=weights))]
+            if jrng is not None:
+                max_new = max(1, max_new + int(jrng.randint(
+                    -spec.max_new_jitter, spec.max_new_jitter + 1
+                )))
             prefix = prefixes[int(rng.randint(spec.shared_prefixes))]
             suffix = rng.randint(
                 1, spec.vocab_size, size=spec.shared_suffix_len

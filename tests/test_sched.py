@@ -433,8 +433,9 @@ def test_every_registered_strategy_carries_a_sched_report():
 
     assert set(DEFAULT_STRATEGIES) == set(xa.STRATEGIES)
     # 14 training + 2 serving (PR 10) + the cached-prefill variant
-    # (PR 11) + the 2 partition-rule-table strategies (PR 12)
-    assert len(DEFAULT_STRATEGIES) == 19
+    # (PR 11) + the 2 partition-rule-table strategies (PR 12) + the
+    # speculative draft/verify pair (PR 13)
+    assert len(DEFAULT_STRATEGIES) == 21
     for name in DEFAULT_STRATEGIES:
         r = cached_strategy_report(name)
         s = r.get("sched")

@@ -1,0 +1,643 @@
+"""Speculative decoding (PR 13): the tiny-LLaMA drafter, the k-token
+draft + single-verify round, jit-safe rollback, and the spec tooling.
+
+The load-bearing pins:
+
+- **spec == sequential, bitwise** — greedy speculative decode through
+  the drafter + verify + truncate path reproduces the dense oracle
+  token for token, across accept-all, reject-first (pinned with a
+  random-weight drafter that never agrees), mid-draft rejection,
+  EOS-inside-draft, and draft windows straddling page boundaries.
+- **pool invariant under spec interleavings** — the seeded sweep
+  (tests/test_serve_prefix.py pattern) holds ``refcount == table refs
+  (+ cache claim)`` on BOTH pools at every step across draft / verify /
+  reject / release / prefix-adopt interleavings, and teardown leaks
+  nothing.
+- **the win is deterministic** — spec-on vs spec-off on the virtual
+  clock at equal admission budget shows a strictly positive advantage
+  on the deep smoke config, with ``serve_report --check-spec-ab``
+  passing the resulting cell (and failing defective ones).
+
+Compile budget: every engine here shares the tiny 2-layer CFG with
+test_serve.py (its tick/prefill/release programs come from the
+module-level jit caches already paid for), the drafter programs are
+shared across every spec engine (one draft cfg, one k), and the deep
+strict-win A/B runs ONCE at module scope.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl25spring_tpu.models import decode as dm, llama
+from ddl25spring_tpu.serve import kv_pages, spec as spec_mod
+from ddl25spring_tpu.serve.engine import ServeEngine
+from ddl25spring_tpu.serve.traffic import TrafficSpec, synth_trace
+from ddl25spring_tpu.utils.config import LlamaConfig, replace
+
+from conftest import cached_lowering
+
+CFG = LlamaConfig(
+    vocab_size=64, dmodel=16, num_heads=2, n_layers=2, ctx_size=32,
+    dtype="float32",
+)
+DEEP_CFG = replace(CFG, n_layers=6)  # the tiny-deep serve model
+K = 3  # one k for every test engine: the draft programs compile once
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_llama_params(jax.random.PRNGKey(0), CFG)
+
+
+def dense_greedy(params, prompt: list[int], max_new: int) -> list[int]:
+    """The dense-cache oracle, compiled once per (|prompt|, max_new)
+    across the whole session (shared with test_serve/test_serve_prefix
+    via the lower-once cache)."""
+
+    def build():
+        toks = dm.generate(
+            params, jnp.asarray([prompt], jnp.int32), CFG,
+            max_new_tokens=max_new, temperature=0.0,
+        )
+        return [int(t) for t in np.asarray(toks)[0]]
+
+    return cached_lowering(("serve-dense", tuple(prompt), max_new), build)
+
+
+def make_engine(params, **kw):
+    kw.setdefault("page_len", 4)
+    kw.setdefault("n_pages", 16)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("pages_per_seq", 4)
+    kw.setdefault("prefill_batch", 1)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("clock", "virtual")
+    kw.setdefault("spec_k", K)
+    return ServeEngine(params, CFG, **kw)
+
+
+def drain(eng, max_steps: int = 500):
+    steps = 0
+    while eng.queue or any(s is not None for s in eng.slots):
+        eng.step()
+        steps += 1
+        assert steps < max_steps, "engine failed to drain"
+
+
+def assert_draft_pool_invariants(eng):
+    """The drafter pool's half of the PR-11 contract: no cache ever
+    claims drafter pages, so ``refcount[p]`` must equal the page-table
+    reference count exactly, and ``free`` the zero-refcount set."""
+    refcount = np.asarray(jax.device_get(eng.draft_pool["refcount"]))
+    free = np.asarray(jax.device_get(eng.draft_pool["free"]))
+    table = np.asarray(jax.device_get(eng.draft_pool["page_table"]))
+    n_pages = free.shape[0]
+    assert (free == (refcount == 0)).all()
+    assert (refcount >= 0).all()
+    table_refs = np.bincount(
+        table[table >= 0].ravel(), minlength=n_pages
+    )[:n_pages]
+    assert (refcount == table_refs).all(), (
+        refcount.tolist(), table_refs.tolist(),
+    )
+
+
+# ------------------------------------------------- truncate_to units
+
+
+def test_truncate_to_frees_rolled_back_pages():
+    pool = kv_pages.init_page_pool(
+        CFG, n_pages=6, page_len=4, max_slots=2, pages_per_seq=4,
+    )
+    # slot 0 allocates entries 0..2 (positions 0, 4, 8)
+    for pos in (0, 4, 8):
+        pool, ok = kv_pages.reserve_pages(
+            pool, jnp.asarray([0, 1]), jnp.asarray([pos, 0]),
+            jnp.asarray([True, False]),
+        )
+        assert bool(ok)
+    pool = {**pool, "seq_len": jnp.asarray([9, 0]),
+            "active": jnp.asarray([True, False])}
+    assert int(kv_pages.used_pages(pool)) == 3
+    # roll back to 5 written positions: entry 2 (start 8) drops, entry
+    # 1 (start 4, holds position 4) is the kept frontier page
+    pool2 = kv_pages.truncate_to(
+        pool, jnp.asarray([5, 0]), jnp.asarray([True, False])
+    )
+    assert int(kv_pages.used_pages(pool2)) == 2
+    table = np.asarray(pool2["page_table"])
+    assert table[0, 0] >= 0 and table[0, 1] >= 0 and table[0, 2] == -1
+    assert int(pool2["seq_len"][0]) == 5
+    # an unmasked slot is untouched even with new_len 0
+    assert (np.asarray(pool2["page_table"])[1]
+            == np.asarray(pool["page_table"])[1]).all()
+    # a new_len at/above the frontier is a no-op (the drafter-pool case
+    # on a fully-accepted round)
+    pool3 = kv_pages.truncate_to(
+        pool, jnp.asarray([12, 0]), jnp.asarray([True, False])
+    )
+    assert int(kv_pages.used_pages(pool3)) == 3
+    assert int(pool3["seq_len"][0]) == 9  # min(9, 12): never grows
+
+
+def test_truncate_to_decrements_shared_pages():
+    """A truncated entry holding a SHARED page (refcount 2) drops one
+    reference and survives — the same discipline as release_slots."""
+    pool = kv_pages.init_page_pool(
+        CFG, n_pages=4, page_len=4, max_slots=2, pages_per_seq=2,
+    )
+    pool, ok = kv_pages.reserve_pages(
+        pool, jnp.asarray([0, 1]), jnp.asarray([0, 0]),
+        jnp.asarray([True, False]),
+    )
+    page = int(np.asarray(pool["page_table"])[0, 0])
+    pool = kv_pages.ref_pages(pool, jnp.asarray([page, -1]))  # cache ref
+    pool = kv_pages.truncate_to(
+        pool, jnp.asarray([0, 0]), jnp.asarray([True, False])
+    )
+    rc = np.asarray(pool["refcount"])
+    assert rc[page] == 1  # the cache's reference survives the rollback
+    assert not bool(np.asarray(pool["free"])[page])
+    assert (np.asarray(pool["page_table"])[0] == -1).all()
+
+
+# ------------------------------------------------- the drafter
+
+
+def test_early_exit_drafter_shapes_and_ratio(params):
+    dp, dcfg = spec_mod.early_exit_drafter(params, CFG, 1)
+    assert dcfg.n_layers == 1 and dcfg.dmodel == CFG.dmodel
+    assert jax.tree.leaves(dp["blocks"])[0].shape[0] == 1
+    # shared leaves are views of the target's, not copies
+    assert dp["embed"] is params["embed"]
+    r = spec_mod.flop_ratio(dp, params)
+    assert 0.0 < r < 1.0
+    # a full-depth "drafter" costs exactly the target
+    full, _ = spec_mod.early_exit_drafter(params, CFG, CFG.n_layers)
+    assert spec_mod.flop_ratio(full, params) == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="draft_layers=0"):
+        spec_mod.early_exit_drafter(params, CFG, 0)
+    with pytest.raises(ValueError, match="draft_layers=3"):
+        spec_mod.early_exit_drafter(params, CFG, 3)
+    # the draft_dim knob slices every projection consistently
+    dp8, dcfg8 = spec_mod.early_exit_drafter(params, CFG, 1, draft_dim=8)
+    assert dcfg8.dmodel == 8 and dcfg8.ffn_dim == 32
+    assert dp8["embed"].shape == (CFG.vocab_size, 8)
+    assert dp8["blocks"]["wq"].shape == (1, 8, 8)
+    assert dp8["blocks"]["w_down"].shape == (1, 32, 8)
+    assert dp8["unembed"].shape == (8, CFG.vocab_size)
+    assert spec_mod.flop_ratio(dp8, params) < r
+    with pytest.raises(ValueError, match="head_dim"):
+        spec_mod.early_exit_drafter(params, CFG, 1, draft_dim=6)
+
+
+def test_spec_refuses_sampling(params):
+    with pytest.raises(ValueError, match="greedy-only"):
+        make_engine(params, temperature=0.7)
+
+
+# --------------------------------------- bitwise spec == sequential
+
+
+@pytest.fixture(scope="module")
+def spec_engine_run(params):
+    """One drained spec engine over prompts chosen to exercise the
+    whole acceptance surface — shared by the bitwise/coverage/pool
+    pins so the draft/verify/truncate programs compile once."""
+    reqs = [
+        ([5, 9, 11, 3], 9),   # crosses the page_len=4 boundary twice
+        ([7, 2, 8], 6),
+        ([1, 2], 4),
+        ([3, 3, 3, 3, 3], 11),  # fills its last page exactly
+    ]
+    eng = make_engine(params)
+    for prompt, max_new in reqs:
+        r = eng.make_request(prompt, max_new)
+        assert eng.submit(r) is None
+        drain(eng)
+    return eng, reqs
+
+
+def test_spec_decode_is_bitwise_dense_across_page_boundaries(
+    params, spec_engine_run
+):
+    """THE tentpole pin: greedy speculative decode — drafts proposed by
+    the early-exit drafter, accepted against the verify pass's
+    argmaxes, rejections rolled back through truncate_to — emits
+    token-for-token the dense oracle's fp32 stream, with draft windows
+    straddling page boundaries along the way."""
+    eng, reqs = spec_engine_run
+    for (prompt, max_new), req in zip(reqs, eng.done):
+        assert req.tokens == dense_greedy(params, prompt, max_new), prompt
+    assert eng.pool_ok_failures == 0
+    # the draft window straddled a page boundary: some round wrote
+    # across a page_len multiple (9 generated from prompt 4 must)
+    assert eng.generated_tokens == sum(m for _, m in reqs)
+
+
+def test_spec_round_coverage_and_counters(params, spec_engine_run):
+    """The acceptance surface the bitwise pin exercised is not
+    vacuous: the deterministic accept histogram covers reject-first
+    (a=0), mid-draft rejection (0<a<k), and full acceptance (a=k) —
+    and the proposed/accepted/rejected counters reconcile."""
+    eng, _reqs = spec_engine_run
+    counts = eng.spec_accept_counts
+    assert counts.get(0, 0) > 0, counts          # reject-first
+    assert any(0 < a < K for a in counts), counts  # mid-draft reject
+    assert counts.get(K, 0) > 0, counts          # accept-all + bonus
+    m = eng.metrics()
+    assert m["acceptance_rate"] > 0
+    assert (m["draft_tokens_accepted"] + m["draft_tokens_rejected"]
+            == m["spec"]["draft_tokens_proposed"]
+            == K * m["spec"]["rounds"])
+    assert m["spec"]["enabled"] and m["spec"]["k"] == K
+    assert 0.0 < m["spec"]["flop_ratio"] < 1.0
+    assert m["config"]["spec_k"] == K
+
+
+def test_spec_pools_drain_clean(params, spec_engine_run):
+    eng, _ = spec_engine_run
+    eng.step()  # flush the final releases
+    assert int(jnp.sum(~eng.pool["free"])) == 0
+    assert int(jnp.sum(~eng.draft_pool["free"])) == 0
+    assert_draft_pool_invariants(eng)
+
+
+def test_reject_first_path_with_a_random_drafter(params):
+    """Bitwise equality must hold for ANY drafter — correctness never
+    depends on agreement.  A drafter with independent random weights
+    agrees ~1/vocab, so nearly every round rejects the FIRST draft
+    (the pure-overhead path); the emitted stream must still be the
+    dense oracle's, token for token."""
+    dcfg = replace(CFG, n_layers=1)
+    rand_draft = llama.init_llama_params(jax.random.PRNGKey(99), dcfg)
+    eng = make_engine(
+        params, draft_params=rand_draft, draft_cfg=dcfg,
+    )
+    prompt, max_new = [5, 9, 11, 3], 9
+    r = eng.make_request(prompt, max_new)
+    assert eng.submit(r) is None
+    drain(eng)
+    assert r.tokens == dense_greedy(params, prompt, max_new)
+    counts = eng.spec_accept_counts
+    assert counts.get(0, 0) > 0
+    m = eng.metrics()
+    assert m["acceptance_rate"] < 0.5  # mostly rejected, still correct
+    assert eng.pool_ok_failures == 0
+
+
+def test_eos_inside_draft_stops_and_frees(params):
+    """EOS landing INSIDE an accepted draft window completes the
+    request at the EOS token (later emissions in the same round are
+    discarded) and the flush returns every page of both pools."""
+    prompt = [5, 9, 11, 3]
+    dense = dense_greedy(params, prompt, 9)
+    eos = dense[3]  # 4th generated token — mid-stream, mid-window
+    eng = make_engine(params, eos_id=eos)
+    req = eng.make_request(prompt, 9)
+    eng.submit(req)
+    drain(eng)
+    assert req.tokens == dense[:4]
+    assert req.tokens[-1] == eos
+    eng.step()  # flush the release
+    assert int(jnp.sum(~eng.pool["free"])) == 0
+    assert int(jnp.sum(~eng.draft_pool["free"])) == 0
+    assert not any(eng.pool["active"].tolist())
+
+
+def test_spec_mid_batch_admission_isolated(params):
+    """Continuous batching under spec: a request admitted while
+    another speculates emits exactly its own dense stream (the shared
+    pools' cross-sequence isolation survives draft/verify/rollback)."""
+    a_prompt, a_new = [5, 9, 11, 3], 9
+    b_prompt, b_new = [7, 2, 8], 6
+    eng = make_engine(params)
+    ra = eng.make_request(a_prompt, a_new)
+    assert eng.submit(ra) is None
+    eng.step()
+    eng.step()
+    assert ra.done_t is None and len(ra.tokens) >= 2
+    rb = eng.make_request(b_prompt, b_new)
+    assert eng.submit(rb) is None
+    eng.step()
+    assert rb.admitted_t is not None
+    drain(eng)
+    assert ra.tokens == dense_greedy(params, a_prompt, a_new)
+    assert rb.tokens == dense_greedy(params, b_prompt, b_new)
+    assert eng.pool_ok_failures == 0
+
+
+def test_spec_max_new_one_completes_in_prefill(params):
+    """A request done at its FIRST token never reaches a spec round;
+    its drafter-pool slot releases with the target's."""
+    prompt = [7, 2]
+    dense = dense_greedy(params, prompt, 1)
+    eng = make_engine(params)
+    r = eng.make_request(prompt, 1)
+    assert eng.submit(r) is None
+    eng.step()
+    assert r.tokens == dense and r.done_t is not None
+    eng.step()
+    assert int(jnp.sum(~eng.draft_pool["free"])) == 0
+
+
+def test_draft_writes_bounded_at_the_table_edge(params):
+    """The draft scan honors the same per-row write limit as verify: a
+    request sized to END exactly at the page table's last position
+    (prompt + max_new == pages_per_seq * page_len) must never have the
+    drafter open a page past the admission bill — an unmasked drafter
+    write at the table edge fails the WHOLE batched reserve_pages call,
+    dropping the OTHER slot's legitimate page and trash-routing its KV.
+    Two such requests run concurrently so the all-or-nothing blast
+    radius would be visible."""
+    eng = make_engine(params, prefill_batch=2)
+    ra = eng.make_request([9, 7, 5, 1], 12)   # 4 + 12 = 16 = table edge
+    rb = eng.make_request([2, 4], 14)         # 2 + 14 = 16
+    assert eng.submit(ra) is None and eng.submit(rb) is None
+    drain(eng)
+    assert ra.tokens == dense_greedy(params, [9, 7, 5, 1], 12)
+    assert rb.tokens == dense_greedy(params, [2, 4], 14)
+    assert eng.pool_ok_failures == 0
+
+
+def test_spec_admission_covers_the_shareless_drafter_pool(params):
+    """The prefix cache discounts matched pages from the TARGET bill,
+    but the drafter pool shares nothing — spec-mode admission must
+    bill the full worst case, or a tight pool with repeated prompts
+    admits a request whose drafter-side reserve exhausts (observed as
+    pool_ok_failures with silently corrupted proposals)."""
+    eng = make_engine(
+        params, n_pages=7, max_slots=2, prefill_batch=2,
+        prefix_cache=True,
+    )
+    prompt = [11, 12, 13, 14, 15, 16, 17, 18]  # 2 full pages, cacheable
+    for _ in range(2):  # identical prompt: the 2nd is a radix hit
+        r = eng.make_request(prompt, 8)  # 8 + 8 = 16 -> 4 pages full
+        assert eng.submit(r) is None
+        drain(eng)
+        assert r.tokens == dense_greedy(params, prompt, 8)
+    assert eng.pool_ok_failures == 0
+    assert eng.prefix.hits >= 1  # the discountless bill kept adoption
+    assert_draft_pool_invariants(eng)
+
+
+# ------------------------------------------ pool-invariant sweep
+
+
+def test_pool_invariants_under_spec_interleavings(params):
+    """The PR-13 satellite sweep: seeded shared-prefix traffic with
+    per-request length jitter against TIGHT pools, speculation AND the
+    radix prefix cache on — draft / verify / reject / COW-adopt /
+    release / evict all interleave — holds the refcount invariant on
+    BOTH pools at every scheduler step, and a full teardown frees
+    every page (no leak, no double-free)."""
+    from test_serve_prefix import assert_pool_invariants
+
+    for seed in (0, 1):
+        rng = np.random.RandomState(seed)
+        eng = make_engine(
+            params, n_pages=8, max_slots=2, prefill_batch=2,
+            prefix_cache=True,
+        )
+        prefixes = [
+            [int(x) for x in rng.randint(1, CFG.vocab_size, size=6)]
+            for _ in range(3)
+        ]
+        for _ in range(40):
+            if rng.uniform() < 0.6:
+                kpfx = int(rng.randint(len(prefixes)))
+                suffix = [int(x) for x in rng.randint(
+                    1, CFG.vocab_size, size=2
+                )]
+                eng.submit(eng.make_request(
+                    prefixes[kpfx] + suffix, int(rng.randint(1, 5))
+                ))
+            eng.step()
+            assert_pool_invariants(eng)
+            assert_draft_pool_invariants(eng)
+        drain(eng)
+        eng.step()
+        assert_pool_invariants(eng)
+        assert_draft_pool_invariants(eng)
+        # teardown: evict the cache; both pools must drain to empty
+        evicted = eng.prefix.evict(eng.n_pages, set())
+        if evicted:
+            pages = np.full((eng.n_pages,), -1, np.int32)
+            pages[: len(evicted)] = evicted
+            eng.pool = kv_pages.unref_pages(eng.pool, jnp.asarray(pages))
+        assert bool(np.asarray(jax.device_get(eng.pool["free"])).all())
+        assert bool(
+            np.asarray(jax.device_get(eng.draft_pool["free"])).all()
+        ), seed
+        assert eng.pool_ok_failures == 0, seed
+
+
+# ------------------------------------------------- the deterministic win
+
+
+def test_spec_ab_strict_win_on_the_deep_config():
+    """The perf claim the CI gate holds: on the tiny-deep smoke config
+    (6-layer target, 1-layer early-exit drafter — FLOP ratio ~0.20)
+    the spec arm strictly beats sequential decode on the virtual clock
+    at equal admission budget, with bitwise-matching streams; and
+    ``serve_report.check_spec_ab`` passes the resulting cell both in
+    ledger-row and serve.json shape."""
+    from ddl25spring_tpu.serve import driver
+    from tools import serve_report
+
+    deep_params = llama.init_llama_params(jax.random.PRNGKey(0), DEEP_CFG)
+    knobs = dict(
+        page_len=4, n_pages=16, max_slots=2, prefill_batch=2,
+        max_prompt_len=8, max_queue=64, token_budget=None, eos_id=None,
+        prefix_cache=False, spec_k=K, draft_layers=1,
+    )
+    spec = TrafficSpec(
+        seed=0, duration_s=2.0, rate_rps=6.0, profile="shared",
+        vocab_size=DEEP_CFG.vocab_size, max_new_jitter=2,
+    )
+    trace = synth_trace(spec)
+    assert len(trace) >= 4
+    sab = driver.spec_ab_compare(deep_params, DEEP_CFG, trace, knobs)
+    assert sab["advantage_tokens"] > 0
+    assert (sab["spec"]["tokens_per_sec_per_chip"]
+            > sab["nospec"]["tokens_per_sec_per_chip"])
+    assert sab["spec"]["drain_wall_s"] < sab["nospec"]["drain_wall_s"]
+    assert sab["tokens_match"] is True
+    assert sab["compared_requests"] > 0
+    assert sab["spec"]["acceptance_rate"] > 0
+    # the gate passes the honest cell in both shapes
+    row = {"key": {"spec": True},
+           "spec_ab": driver._spec_ab_cell(sab)}
+    assert serve_report.check_spec_ab([row]) == []
+    doc = {"key": {"spec": True}, "spec_ab": sab}
+    assert serve_report.check_spec_ab([doc]) == []
+
+
+# --------------------------------------------------- report gates
+
+
+def test_check_spec_ab_fails_on_defects():
+    from tools import serve_report
+
+    assert serve_report.check_spec_ab(
+        [{"key": {"spec": True}}]
+    ) != []  # no cell at all
+    bad = {
+        "key": {"spec": True},
+        "spec_ab": {
+            "budget_s": 1.0,
+            "spec_tokens_at_budget": 10,
+            "nospec_tokens_at_budget": 12,
+            "advantage_tokens": -2,
+            "tokens_match": False,
+            "compared_requests": 3,
+            "spec_tokens_per_sec_per_chip": 5.0,
+            "nospec_tokens_per_sec_per_chip": 6.0,
+            "acceptance_rate": 0.0,
+            "draft_tokens_accepted": 0,
+        },
+    }
+    fails = serve_report.check_spec_ab([bad])
+    assert len(fails) == 4  # accepted, tps, budget, match
+    assert any("accepted" in f for f in fails)
+    # tokens_match=True over ZERO compared requests is vacuous — the
+    # same guard the prefix gate grew in PR 11
+    vacuous = {
+        "key": {"spec": True},
+        "spec_ab": {
+            **bad["spec_ab"],
+            "advantage_tokens": 2,
+            "draft_tokens_accepted": 9,
+            "acceptance_rate": 0.5,
+            "spec_tokens_per_sec_per_chip": 7.0,
+            "tokens_match": True,
+            "compared_requests": 0,
+        },
+    }
+    fails = serve_report.check_spec_ab([vacuous])
+    assert len(fails) == 1 and "compared request" in fails[0]
+
+
+def test_check_group_gates_acceptance_rate_on_spec_runs():
+    from tools import serve_report
+
+    def row(acc):
+        return {
+            "key": {"spec": True, "profile": "shared"},
+            "tokens_per_sec_per_chip": 10.0,
+            "ttft_s_p95": 0.1,
+            "prefix_hit_rate": 0.8,
+            "acceptance_rate": acc,
+        }
+
+    assert serve_report.check_group([row(0.6), row(0.5)]) == []
+    fails = serve_report.check_group([row(0.6), row(0.6), row(0.1)])
+    assert any("acceptance_rate" in f for f in fails)
+    # NOT gated off spec runs (the key carries no spec marker)
+    cold = [
+        {k: v for k, v in r.items() if k != "key"} | {"key": {}}
+        for r in (row(0.6), row(0.6), row(0.0))
+    ]
+    assert serve_report.check_group(cold) == []
+
+
+def test_ledger_and_cells_carry_the_spec_contract():
+    """ledger_record / serve_cell / _spec_ab_cell thread the spec
+    counters and the A/B verdict end to end (pure dict plumbing — no
+    engine, no compile)."""
+    from ddl25spring_tpu.serve import driver
+
+    record = {
+        "record": "serve", "ts": 1.0, "git_sha": "abc", "host": "h",
+        "key": {"spec": True, "spec_k": K, "draft_layers": 1},
+        "requests": 3,
+        "ramp": {
+            "tokens_per_sec_per_chip": 10.0,
+            "acceptance_rate": 0.6,
+            "draft_tokens_accepted": 12,
+            "draft_tokens_rejected": 8,
+            "spec": {"enabled": True, "k": K, "rounds": 7},
+        },
+        "spec_ab": {
+            "budget_s": 2.0,
+            "spec_tokens_at_budget": 30,
+            "nospec_tokens_at_budget": 25,
+            "advantage_tokens": 5,
+            "advantage_frac": 0.2,
+            "tokens_match": True,
+            "compared_requests": 3,
+            "spec": {"tokens_per_sec_per_chip": 12.0,
+                     "acceptance_rate": 0.6,
+                     "draft_tokens_accepted": 12,
+                     "draft_tokens_rejected": 8},
+            "nospec": {"tokens_per_sec_per_chip": 10.0},
+        },
+    }
+    row = driver.ledger_record(record)
+    assert row["acceptance_rate"] == 0.6
+    assert row["draft_tokens_accepted"] == 12
+    assert row["draft_tokens_rejected"] == 8
+    assert row["spec_ab"]["advantage_tokens"] == 5
+    assert row["spec_ab"]["spec_tokens_per_sec_per_chip"] == 12.0
+    assert row["spec_ab"]["acceptance_rate"] == 0.6
+    cell = driver.serve_cell(record)
+    assert cell["acceptance_rate"] == 0.6
+    assert cell["spec"]["enabled"] is True
+    assert cell["spec_ab"]["tokens_match"] is True
+    assert cell["spec_ab"]["compared_requests"] == 3
+
+
+# -------------------------------------------------------- traffic
+
+
+def test_shared_profile_max_new_jitter_is_seeded():
+    base = TrafficSpec(
+        seed=5, duration_s=3.0, rate_rps=8.0, profile="shared",
+    )
+    jit = TrafficSpec(
+        seed=5, duration_s=3.0, rate_rps=8.0, profile="shared",
+        max_new_jitter=2,
+    )
+    t0 = synth_trace(base)
+    t1 = synth_trace(jit)
+    assert len(t0) == len(t1) > 4
+    # jitter=0 (the field default) replays the exact pre-knob stream
+    assert synth_trace(base) == t0
+    # the knob actually varies decode lengths, within +-jitter, >= 1
+    assert {r["max_new"] for r in t1} != {r["max_new"] for r in t0}
+    for a, b in zip(t0, t1):
+        assert a["prompt"] == b["prompt"] and a["t"] == b["t"]
+        assert abs(a["max_new"] - b["max_new"]) <= 2
+        assert b["max_new"] >= 1
+    # restart-deterministic like the rest of the profile
+    assert synth_trace(jit) == t1
+
+
+# ------------------------------------------------- compile signatures
+
+
+@pytest.mark.parametrize("name,ar_count", [
+    # draft: 2 psums/block x 1 drafter layer x (k+1 = 3) scan steps
+    ("serve-draft", 2 * 1 * 3),
+    # verify: 2 psums/block x 2 target layers x (k+1) positions — the
+    # counts differing by exactly the depth ratio is the compile-time
+    # half of the drafter's FLOP-ratio pricing
+    ("serve-verify", 2 * 2 * 3),
+])
+def test_spec_signature_pins(strategy_report, name, ar_count):
+    """Speculative TP serving traffic is the row-parallel all-reduce
+    ONLY — pinned through the same registry gates as every strategy
+    (lower-once session cache shared with graft-lint/graft-sched)."""
+    r = strategy_report(name)
+    assert r["signature_violations"] == []
+    assert [f for f in r["findings"] if not f["waived"]] == []
+    totals = r["collectives"]["totals"]
+    assert set(totals) == {"all-reduce"}
+    assert totals["all-reduce"]["count"] == ar_count
+    assert r["sched"]["hazards"] == []
+    assert r["lowered"] in ("draft_step", "verify_step")
+    assert r["meta"]["kv_sharded_dim"] == 3

@@ -30,7 +30,18 @@ comparison the driver records).  ``--check-prefix-ab`` adds the radix
 prefix cache's (PR 11): the latest row's cached-vs-cold cell must show
 ``prefill_tokens_saved > 0``, a strictly higher cached virtual-clock
 tokens/sec/chip, tokens delivered strictly ahead at the fixed budget,
-and bitwise-matching token streams.
+and bitwise-matching token streams.  ``--check-spec-ab`` adds the
+speculative-decoding verdict (PR 13): the latest row's spec-on-vs-off
+cell must show real accepted draft tokens, a strictly higher
+speculative virtual-clock tokens/sec/chip at equal admission budget,
+tokens delivered strictly ahead at the fixed budget, and
+bitwise-matching token streams over >= 1 compared request (greedy
+speculation IS the target's own output — an empty comparison would
+pass the bitwise gate vacuously, so it fails instead).  On spec runs
+(``spec`` in the key) ``acceptance_rate`` joins the banded trend keys:
+deterministic on the seeded trace, it collapses when the drafter or
+the acceptance walk regresses, long before the noisy wall clocks
+notice.
 
 Pure stdlib — no jax import, so the gate runs anywhere the JSON does.
 """
@@ -145,12 +156,33 @@ def check_group(
                     f"{(1 - tolerance):.2f}x band under the baseline "
                     f"{b_hit:.3f} on a shared-prefix run"
                 )
+    if _is_spec(latest):
+        # acceptance_rate is equally deterministic on a seeded trace
+        # (greedy drafter vs greedy target): a collapse means the
+        # drafter construction or the acceptance walk regressed
+        b_acc = _median([
+            r["acceptance_rate"] for r in base
+            if isinstance(r.get("acceptance_rate"), (int, float))
+        ])
+        l_acc = latest.get("acceptance_rate")
+        if b_acc and isinstance(l_acc, (int, float)):
+            if l_acc < b_acc * (1.0 - tolerance):
+                fails.append(
+                    f"acceptance_rate {l_acc:.3f} fell below the "
+                    f"{(1 - tolerance):.2f}x band under the baseline "
+                    f"{b_acc:.3f} on a speculative run"
+                )
     return fails
 
 
 def _is_shared_prefix(rec: dict) -> bool:
     key = rec.get("key")
     return isinstance(key, dict) and key.get("profile") == "shared"
+
+
+def _is_spec(rec: dict) -> bool:
+    key = rec.get("key")
+    return isinstance(key, dict) and bool(key.get("spec"))
 
 
 def _ramp_or_top(rec: dict, name: str):
@@ -264,6 +296,86 @@ def check_prefix_ab(recs: list[dict]) -> list[str]:
     return fails
 
 
+def check_spec_ab(recs: list[dict]) -> list[str]:
+    """The speculative-decoding acceptance verdict on the latest row
+    (PR 13): the spec-on-vs-off cell must exist and show real accepted
+    draft work, a strict virtual-clock win at equal admission budget,
+    and bitwise-matching token streams over at least one compared
+    request (greedy speculation must BE the target's own output — an
+    empty intersection would pass ``all()`` vacuously, the same hole
+    the PR-11 ``compared_requests`` guard closed for the prefix gate).
+    """
+    if not recs:
+        return []
+    latest = recs[-1]
+    sab = latest.get("spec_ab")
+    if not isinstance(sab, dict):
+        return ["latest record carries no spec A/B cell (run with "
+                "DDL25_SERVE_SPEC=1 and without --no-serve-spec-ab "
+                "to record one)"]
+    # a ledger row carries the flattened cell; a serve.json doc carries
+    # the driver's full output with spec/nospec sub-dicts — accept both
+    spec_arm = sab.get("spec") or {}
+    nospec_arm = sab.get("nospec") or {}
+    sab = {
+        **sab,
+        "spec_tokens_per_sec_per_chip": sab.get(
+            "spec_tokens_per_sec_per_chip",
+            spec_arm.get("tokens_per_sec_per_chip"),
+        ),
+        "nospec_tokens_per_sec_per_chip": sab.get(
+            "nospec_tokens_per_sec_per_chip",
+            nospec_arm.get("tokens_per_sec_per_chip"),
+        ),
+        "draft_tokens_accepted": sab.get(
+            "draft_tokens_accepted",
+            spec_arm.get("draft_tokens_accepted"),
+        ),
+        "acceptance_rate": sab.get(
+            "acceptance_rate", spec_arm.get("acceptance_rate")
+        ),
+    }
+    fails: list[str] = []
+    accepted = sab.get("draft_tokens_accepted")
+    if not isinstance(accepted, (int, float)) or accepted <= 0:
+        fails.append(
+            f"the drafter contributed no accepted tokens "
+            f"(draft_tokens_accepted={accepted}, acceptance_rate="
+            f"{sab.get('acceptance_rate')}); speculation that never "
+            "accepts only ever costs"
+        )
+    spec_tps = sab.get("spec_tokens_per_sec_per_chip")
+    nospec_tps = sab.get("nospec_tokens_per_sec_per_chip")
+    if not (isinstance(spec_tps, (int, float))
+            and isinstance(nospec_tps, (int, float))
+            and spec_tps > nospec_tps):
+        fails.append(
+            f"speculative engine not strictly faster on the virtual "
+            f"clock: spec {spec_tps} vs non-spec {nospec_tps} "
+            "tokens/sec/chip at equal admission budget"
+        )
+    adv = sab.get("advantage_tokens")
+    if not isinstance(adv, (int, float)) or adv <= 0:
+        fails.append(
+            f"speculative engine not ahead at the fixed budget: spec "
+            f"{sab.get('spec_tokens_at_budget')} vs non-spec "
+            f"{sab.get('nospec_tokens_at_budget')} tokens (budget "
+            f"{sab.get('budget_s')} s)"
+        )
+    cmp_n = sab.get("compared_requests")
+    if sab.get("tokens_match") is not True or not (
+        isinstance(cmp_n, int) and cmp_n > 0
+    ):
+        fails.append(
+            "speculative decode did not reproduce the sequential "
+            f"engine token-for-token (tokens_match="
+            f"{sab.get('tokens_match')} over {cmp_n} compared "
+            "request(s); the comparison must cover at least one "
+            "request)"
+        )
+    return fails
+
+
 def histogram(xs: list[float], *, bins: int = 10, width: int = 40,
               scale: float = 1e3, unit: str = "ms") -> list[str]:
     """ASCII histogram lines (log-ish readable, linear bins)."""
@@ -327,6 +439,20 @@ def format_run(doc: dict) -> str:
             f"  cached pages {prefix.get('cached_pages')}  evictions "
             f"{prefix.get('evictions')}"
         )
+    spec = ramp.get("spec") or {}
+    if spec.get("enabled"):
+        lines.append(
+            f"  speculative decode: k={spec.get('k')} drafter "
+            f"{spec.get('draft_layers')}L/{spec.get('draft_dim')}d "
+            f"(flop ratio {_fmt(spec.get('flop_ratio'), 2)})  "
+            f"acceptance "
+            f"{_fmt(ramp.get('acceptance_rate'), 1, 100, '%')} "
+            f"({ramp.get('draft_tokens_accepted')} accepted / "
+            f"{ramp.get('draft_tokens_rejected')} rejected)  "
+            f"rounds {spec.get('rounds')}  draft steps "
+            f"{spec.get('draft_steps')}  accepts by prefix "
+            f"{spec.get('accept_counts')}"
+        )
     ab = doc.get("ab")
     if ab:
         lines += [
@@ -360,6 +486,27 @@ def format_run(doc: dict) -> str:
             f"  saved {cached.get('prefill_tokens_saved')} tokens  "
             f"tokens match {pab.get('tokens_match')}",
         ]
+    sab = doc.get("spec_ab")
+    if sab:
+        spec_arm = sab.get("spec") or {}
+        nospec_arm = sab.get("nospec") or {}
+        lines += [
+            "",
+            "  spec-on-vs-off A/B (virtual clock, budget "
+            f"{_fmt(sab.get('budget_s'), 3)} s, equal admission "
+            "budget; verify = 1 tick, drafter at its FLOP ratio):",
+            f"    spec {sab.get('spec_tokens_at_budget')} tokens  "
+            f"non-spec {sab.get('nospec_tokens_at_budget')} tokens  "
+            f"advantage {sab.get('advantage_tokens')} "
+            f"({_fmt(sab.get('advantage_frac'), 1, 100, '%')})",
+            f"    tokens/sec/chip spec "
+            f"{_fmt(spec_arm.get('tokens_per_sec_per_chip'), 2)}"
+            f" vs non-spec "
+            f"{_fmt(nospec_arm.get('tokens_per_sec_per_chip'), 2)}"
+            f"  acceptance "
+            f"{_fmt(spec_arm.get('acceptance_rate'), 1, 100, '%')}"
+            f"  tokens match {sab.get('tokens_match')}",
+        ]
     if doc.get("ttft_s"):
         lines += ["", "  TTFT histogram:"] + histogram(doc["ttft_s"])
     if doc.get("tick_wall_s"):
@@ -378,6 +525,7 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
         f"{'ttft p50':>11}{'ttft p95':>11}{'tok p95':>11}"
         f"{'adm':>5}{'rej':>5}{'pool%':>7}{'ab adv':>8}"
         f"{'hit%':>7}{'saved':>7}{'pfx adv':>8}"
+        f"{'acc%':>7}{'dacc':>6}{'spec adv':>9}"
     )
     lines.append(cols)
     lines.append("  " + "-" * (len(cols) - 2))
@@ -391,6 +539,7 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
         sha = (rec.get("git_sha") or "?")[:7]
         ab = rec.get("ab") or {}
         pab = rec.get("prefix_ab") or {}
+        sab = rec.get("spec_ab") or {}
         lines.append(
             f"  {when:<20}{sha:<9}"
             f"{_fmt(rec.get('tokens_per_sec_per_chip'), 2):>11}"
@@ -404,6 +553,9 @@ def format_group(key: tuple, recs: list[dict], last: int) -> str:
             f"{_fmt(rec.get('prefix_hit_rate'), 0, 100, '%'):>7}"
             f"{_fmt(rec.get('prefill_tokens_saved'), 0):>7}"
             f"{_fmt(pab.get('advantage_tokens'), 0):>8}"
+            f"{_fmt(rec.get('acceptance_rate'), 0, 100, '%'):>7}"
+            f"{_fmt(rec.get('draft_tokens_accepted'), 0):>6}"
+            f"{_fmt(sab.get('advantage_tokens'), 0):>9}"
         )
     return "\n".join(lines)
 
@@ -439,8 +591,14 @@ def main(argv=None) -> int:
                          "cold prefix A/B does not show skipped prefill "
                          "work, a strict virtual-clock win, and "
                          "matching token streams (implies --check)")
+    ap.add_argument("--check-spec-ab", action="store_true",
+                    help="also fail when the latest row's speculative "
+                         "spec-on-vs-off A/B does not show accepted "
+                         "draft tokens, a strict virtual-clock win, and "
+                         "matching token streams over >= 1 compared "
+                         "request (implies --check)")
     args = ap.parse_args(argv)
-    if args.check_ab or args.check_prefix_ab:
+    if args.check_ab or args.check_prefix_ab or args.check_spec_ab:
         args.check = True  # a verdict nobody reads is not a gate
 
     if args.run_dir is None and not args.ledger_only:
@@ -481,19 +639,23 @@ def main(argv=None) -> int:
                 fails += check_ab(recs)
             if args.check_prefix_ab:
                 fails += check_prefix_ab(recs)
+            if args.check_spec_ab:
+                fails += check_spec_ab(recs)
         if len(recs) < 2:
             if not fails:
                 note = "no baseline yet (single record)"
         else:
             fails += check_group(recs, args.tolerance, args.window)
         verdicts[key] = {"fails": fails, "note": note}
-    if ((args.check_ab or args.check_prefix_ab)
+    if ((args.check_ab or args.check_prefix_ab or args.check_spec_ab)
             and ab_scope is not None and ab_scope not in groups):
         # the run under test never landed in this ledger (custom
         # --ledger path): judge its serve.json directly
         fails = check_ab([doc]) if args.check_ab else []
         if args.check_prefix_ab:
             fails += check_prefix_ab([doc])
+        if args.check_spec_ab:
+            fails += check_spec_ab([doc])
         verdicts[ab_scope] = {"fails": fails, "note": None}
     bad = sum(len(v["fails"]) for v in verdicts.values())
 
@@ -515,6 +677,8 @@ def main(argv=None) -> int:
         ab_note = ", A/B advantage verified" if args.check_ab else ""
         if args.check_prefix_ab:
             ab_note += ", prefix A/B advantage verified"
+        if args.check_spec_ab:
+            ab_note += ", spec A/B advantage verified"
         print(f"\nserve check OK: {len(groups)} key(s) within the "
               f"{args.tolerance:.2f} tolerance band{ab_note}",
               file=sys.stderr)
