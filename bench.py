@@ -443,9 +443,13 @@ def run_with_retries(
                 if died_at is not None:
                     steps_lost += max(0, died_at - resume_step)
         # preemption skips the backoff: the accelerator is healthy, the
-        # process was just told to die — relaunch (and resume) now
+        # process was just told to die — relaunch (and resume) now.
+        # Armed chaos skips it too: every chaos death is SIMULATED (the
+        # device never actually went away), so waiting out a tunnel
+        # backoff would bill fake recovery time to the relaunch path —
+        # exactly the number the elastic-vs-relaunch A/B compares.
         delay = (
-            0.0 if reason == REASON_PREEMPTED
+            0.0 if reason == REASON_PREEMPTED or chaos_spec
             else backoff[min(i, len(backoff) - 1)]
         ) if i + 1 < attempts else 0.0
         rec = {
@@ -522,6 +526,13 @@ def fedavg_secondary(n_rounds: int = 10) -> dict:
 
 
 def main(argv=None) -> None:
+    import time as _time
+
+    # anchor for recovery_wall_s: how long a relaunched child takes from
+    # process entry to "training again" — the checkpoint-relaunch side
+    # of the elastic-vs-relaunch recovery A/B (the elastic side measures
+    # its in-process reshape against the same clock kind)
+    t_main0 = _time.perf_counter()
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (local testing; the axon TPU "
@@ -574,6 +585,19 @@ def main(argv=None) -> None:
                          "the latest durable checkpoint and continue the "
                          "primary phase from the next step (the retry "
                          "driver passes this automatically on relaunch)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="survive device_loss / capacity_change chaos "
+                         "IN-PROCESS by reshaping onto the surviving "
+                         "mesh (ddl25spring_tpu.ft.elastic): live state "
+                         "re-lands device-to-device, the step re-lowers "
+                         "on the survivor mesh, the run continues from "
+                         "the data cursor — no relaunch, no checkpoint "
+                         "round-trip.  Implies pure DP at single-step "
+                         "dispatch granularity; with --smoke a 2-device "
+                         "CPU mesh so a loss is survivable.  A "
+                         "capacity_change target that does not divide "
+                         "the global batch is lowered to the largest "
+                         "device count that does")
     ap.add_argument("--perf-reps", type=int, default=8, metavar="K",
                     help="barriered step reps for the measured perf "
                          "record (ddl25spring_tpu.obs.perfscope: "
@@ -659,6 +683,19 @@ def main(argv=None) -> None:
         args.scan_steps = args.scan_steps or 1
         args.obs_dir = args.obs_dir or "runs/bench_smoke"
         os.environ.setdefault("DDL25_BENCH_NTRAIN", "512")
+    if args.elastic:
+        # the reshape boundary is a dispatch boundary: elastic runs at
+        # single-step granularity (a K-fused scan dispatch would make
+        # "the in-flight step" K steps wide) and in pure DP — the
+        # layout whose re-lower the reshape path covers today
+        if args.scan_steps not in (0, 1):
+            print("--elastic forces --scan-steps 1 (reshape operates at "
+                  "single-dispatch granularity)", file=sys.stderr)
+        args.scan_steps = 1
+        if args.smoke and not args.force_cpu_devices:
+            # a 1-device smoke has nothing to lose; fake two CPU
+            # devices so device_loss@k has a survivor to reshape onto
+            args.force_cpu_devices = 2
 
     on_cpu = args.cpu or args.force_cpu_devices
     is_child = os.environ.get("DDL25_BENCH_CHILD") == "1"
@@ -668,7 +705,9 @@ def main(argv=None) -> None:
     # on a CPU run still needs the subprocess wrapper — the relaunch IS
     # the recovery mechanism the chaos exists to exercise
     chaos_spec = os.environ.get("DDL25_CHAOS")
-    if chaos_spec and not args.save_every:
+    if chaos_spec and not args.save_every and not args.serve:
+        # serve mode has no checkpoint loop: its chaos kinds drive the
+        # elastic replica reshaping inside the serve driver instead
         args.save_every = 2
     resilient = bool(args.save_every or args.resume_from)
     ckpt_dir = args.ckpt_dir or args.resume_from or (
@@ -870,8 +909,9 @@ def main(argv=None) -> None:
     if args.stages:
         S = args.stages
         dp = max(n // S, 1)
-    elif args.overlap:
-        # overlap restructures the DP gradient path; pin the layout
+    elif args.overlap or args.elastic:
+        # overlap restructures the DP gradient path; elastic reshapes
+        # it — both pin the pure-DP layout
         dp, S = n, 1
     else:
         dp, S = (n // 2, 2) if n >= 2 else (1, 1)
@@ -921,6 +961,14 @@ def main(argv=None) -> None:
     chaos_exc: tuple = ()
     start_step = 0
     replayed = None
+    recovery_wall_s = None
+    # chaos kinds an elastic run CLAIMS at segment boundaries via
+    # chaos.take (ft/elastic.py): on_step must not execute their
+    # default raise-and-die action out from under the reshape path
+    elastic_skip = (
+        ("device_loss", "capacity_change") if args.elastic else ()
+    )
+    reshape_events: list = []
     if resilient or chaos_spec:
         from ddl25spring_tpu.ft import (
             AutoSaver,
@@ -950,6 +998,11 @@ def main(argv=None) -> None:
                 meta["mesh"],
             )
             state, start_step = saver.restore_or_init(init)
+            # the relaunch path's recovery bill: process entry ->
+            # restored and ready to train (imports, backend dial, and
+            # the checkpoint read all inside); the elastic path's
+            # reshape wall is the in-process counterpart
+            recovery_wall_s = round(_time.perf_counter() - t_main0, 3)
             if start_step:
                 params, opt_state = state["params"], state["opt_state"]
                 ds.cursor = int(state["data_cursor"])
@@ -970,7 +1023,7 @@ def main(argv=None) -> None:
             at step i fires BEFORE step i's state can become durable —
             maximum honest replay), then the gated autosave."""
             if chaos is not None:
-                chaos.on_step(i)
+                chaos.on_step(i, skip=elastic_skip)
             if saver is not None:
                 saver.maybe_save(
                     i,
@@ -1048,12 +1101,106 @@ def main(argv=None) -> None:
         else:
             resumed_past_end = start_step >= args.steps
             steps_run = max(args.steps - start_step, 1)
-            dt, params, opt_state = timed_run(
-                step, params, opt_state, ds.feed, steps_run, args.warmup,
-                logger=lg, label="hbm-single", samples_per_step=batch,
-                on_step=ft_on_step, step_offset=start_step,
-            )
-            sps_chip = steps_run * batch / dt / n_chips
+            end_step = start_step + steps_run
+            # the elastic plan: armed device_loss / capacity_change
+            # faults inside this run's step window become SEGMENT
+            # boundaries — each segment is an ordinary timed_run, and
+            # between segments the taken fault is answered with an
+            # in-process reshape instead of a death (ft/elastic.py).
+            # Chaos fires post-step by contract, so the boundary split
+            # is observationally identical to an in-loop fault: step k
+            # completes, THEN the mesh changes.
+            elastic_plan = sorted(
+                (
+                    f for f in (chaos.pending() if chaos else ())
+                    if f.kind in elastic_skip
+                    and start_step <= f.step < end_step
+                ),
+                key=lambda f: f.step,
+            ) if args.elastic else []
+            dt = 0.0
+            chip_s = 0.0  # chip-seconds: each segment billed at ITS width
+            seg_start = start_step
+            mesh_now = meta["mesh"]
+            for fault in [*elastic_plan, None]:
+                seg_end = end_step if fault is None else fault.step + 1
+                if seg_end > seg_start:
+                    dt_i, params, opt_state = timed_run(
+                        step, params, opt_state, ds.feed,
+                        seg_end - seg_start,
+                        # the continuation segment must not burn feed
+                        # batches (and mutate params) on re-warmup; the
+                        # rebuilt step compiles on its first timed
+                        # dispatch — that compile IS part of the
+                        # recovery story and stays in the measurement
+                        args.warmup if seg_start == start_step else 0,
+                        logger=lg, label="hbm-single",
+                        samples_per_step=batch,
+                        on_step=ft_on_step, step_offset=seg_start,
+                    )
+                    dt += dt_i
+                    chip_s += dt_i * n_chips
+                    seg_start = seg_end
+                if fault is None:
+                    break
+                if not chaos.take(fault.step, kinds=(fault.kind,)):
+                    continue  # journaled in a previous life: one-shot
+                from ddl25spring_tpu.ft import elastic
+
+                t0r = time.perf_counter()
+                n_now = meta["n_chips"]
+                target = (
+                    fault.arg if fault.kind == "capacity_change"
+                    and fault.arg else max(1, n_now // 2)
+                )
+                if target > len(devices):
+                    # a capacity grant beyond the attached devices
+                    # lowers to what exists — growing is best-effort,
+                    # only shrinking is forced on us
+                    print(f"elastic: capacity_change target {target} "
+                          f"exceeds {len(devices)} attached device(s); "
+                          "lowering", file=sys.stderr)
+                    target = len(devices)
+                while batch % target:  # keep the global batch exact
+                    target -= 1
+                new_devs = elastic.surviving_devices(
+                    devices, size=target
+                )
+                step, p_t, o_t, meta = build_resnet_step(
+                    new_devs, target, 1, 1, batch, overlap=args.overlap
+                )
+                state = elastic.reshape_state(
+                    {"params": params, "opt_state": opt_state},
+                    with_mesh_placement(
+                        {"params": p_t, "opt_state": o_t}, meta["mesh"]
+                    ),
+                )
+                params, opt_state = state["params"], state["opt_state"]
+                wall = time.perf_counter() - t0r
+                # the faulted step completed and its loss synced before
+                # the post-step fault fired — nothing was in flight, so
+                # steps_lost is 0 by construction (vs the relaunch
+                # path's died_at - durable gap)
+                reshape_events.append(elastic.record_reshape(
+                    old=mesh_now, new=meta["mesh"], wall_s=wall,
+                    steps_lost=0, reason=fault.kind, step=fault.step,
+                ))
+                if saver is not None:
+                    saver.note_reshape(
+                        old=reshape_events[-1]["old"],
+                        new=reshape_events[-1]["new"],
+                        step=fault.step,
+                    )
+                mesh_now = meta["mesh"]
+                n_chips = meta["n_chips"]
+                flight.annotate(
+                    layout=meta["layout"], topology=meta["topology"],
+                    n_chips=n_chips,
+                )
+            # per-chip throughput over chip-seconds: a mid-run reshape
+            # means segments ran at DIFFERENT widths — dividing the
+            # whole wall by the final width would overstate the number
+            sps_chip = steps_run * batch / chip_s
             dt_per_step = dt / steps_run
             sps_chip_single = None
     except chaos_exc as e:
@@ -1234,6 +1381,29 @@ def main(argv=None) -> None:
             "ckpt_dir": ckpt_dir,
             "saves": saver.saves,
             "saves_skipped": saver.skipped,
+            # the elastic-vs-relaunch A/B facts (ft/elastic.py): the
+            # in-process reshape count + walls on the elastic side, the
+            # entry->restored wall on the relaunch side — steps lost
+            # ride total_steps_lost either way (0 for a reshape, the
+            # died_at - durable gap for a relaunch, merged by the retry
+            # parent)
+            **({
+                "reshapes": len(reshape_events),
+                "reshape": reshape_events,
+                "reshape_wall_s": round(
+                    sum(e["wall_s"] for e in reshape_events), 3
+                ),
+                "recovery_wall_s": round(
+                    sum(e["wall_s"] for e in reshape_events), 3
+                ),
+                "total_steps_lost": sum(
+                    e["steps_lost"] for e in reshape_events
+                ),
+            } if reshape_events else {}),
+            **({
+                "recovery_wall_s": recovery_wall_s,
+            } if recovery_wall_s is not None and not reshape_events
+              else {}),
         }
 
     # runtime-health cell: sentinel state + flight-recorder facts, and a

@@ -15,7 +15,12 @@ health stack, built from three cooperating pieces:
   :mod:`~ddl25spring_tpu.ft.manifest`);
 - :mod:`~ddl25spring_tpu.ft.reshard` — cross-mesh restore: ZeRO shard
   state saved on ``n`` devices re-lands exactly on a smaller surviving
-  mesh.
+  mesh (and, since PR 14, live ``jax.Array`` state device-to-device
+  through the no-host-copy fast path);
+- :mod:`~ddl25spring_tpu.ft.elastic` — in-run mesh reshaping (PR 14):
+  on ``device_loss`` / ``capacity_change`` the running process
+  re-lands its live state on the survivor mesh and re-lowers the
+  strategy instead of dying into a checkpoint relaunch.
 
 ``bench.py`` wires all three into its retry driver (``--save-every`` /
 ``--resume-from``); :mod:`~ddl25spring_tpu.ft.demo` is the minimal
@@ -35,6 +40,11 @@ _EXPORTS = {
     "DeviceLossError": "chaos",
     "Fault": "chaos",
     "parse_chaos": "chaos",
+    "SIGNAL_KINDS": "chaos",
+    "record_reshape": "elastic",
+    "relower": "elastic",
+    "reshape_state": "elastic",
+    "surviving_devices": "elastic",
     "MANIFEST_BASENAME": "manifest",
     "latest_durable_step": "manifest",
     "read_manifest": "manifest",

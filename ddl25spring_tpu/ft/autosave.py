@@ -260,6 +260,24 @@ class AutoSaver:
             **({"meta": self._meta} if self._meta else {}),
         })
 
+    def note_reshape(self, **facts) -> None:
+        """The elastic-reshape notification (PR 14,
+        :mod:`ddl25spring_tpu.ft.elastic`): after an in-run mesh
+        reshape the live state's leaf shapes are the NEW mesh's — the
+        recorded ``leaf_shapes`` (old mesh) are stale, and a later
+        cross-mesh resume keys its abstract restore template on them.
+        Dropping the cache makes the next save re-record the truth;
+        ``facts`` (old/new mesh sizes…) land in the manifest meta so
+        the post-mortem names the reshape lineage."""
+        self._leaf_shapes = None
+        # the prior manifest's leaf_shapes describe the OLD layout too:
+        # a close() before the next save must not resurrect them under
+        # a state that no longer has those shapes
+        self._prior_manifest = dict(self._prior_manifest)
+        self._prior_manifest.pop("leaf_shapes", None)
+        if facts:
+            self._meta = {**self._meta, "reshape": facts}
+
     # ---- restoring ------------------------------------------------------
 
     def restore_or_init(self, init_state: Any) -> tuple[Any, int]:

@@ -10,7 +10,7 @@ so a kill-and-resume test is a deterministic program, not a race.
 Spec grammar (``DDL25_CHAOS``, or any string handed to
 :func:`parse_chaos`)::
 
-    <kind>@<step>[,<kind>@<step>...]
+    <kind>@<step>[:<arg>][,<kind>@<step>[:<arg>]...]
 
     sigterm@12      os.kill(self, SIGTERM) after step 12 completes —
                     the scheduler-preemption path (the flight
@@ -23,16 +23,39 @@ Spec grammar (``DDL25_CHAOS``, or any string handed to
                     inside the compiled step, which is exactly what
                     the PR-5 sentinels exist to observe
     device_loss@9   raise :class:`DeviceLossError` after step 9 — the
-                    simulated hardware-churn path; ``bench.py``
-                    classifies it ``device_unreachable`` and its retry
-                    driver relaunches with ``--resume-from``
+                    simulated hardware-churn path.  Under plain
+                    ``bench.py`` it is classified
+                    ``device_unreachable`` and the retry driver
+                    relaunches with ``--resume-from`` (PR 6); under
+                    ``bench.py --elastic`` (and the elastic serve
+                    driver) the SAME fault is consumed via
+                    :meth:`ChaosInjector.take` and answered with an
+                    in-run mesh/replica reshape instead of a death
+                    (PR 14, :mod:`ddl25spring_tpu.ft.elastic`)
+    traffic_spike@8[:B]
+                    SIGNAL kind (never kills): an elastic serving
+                    driver polls it via :meth:`ChaosInjector.take`
+                    and injects a deterministic burst of ``B`` extra
+                    arrivals (driver default when omitted) at
+                    scheduler iteration 8 — the overload that drives
+                    replica scale-UP
+    capacity_change@5[:N]
+                    SIGNAL kind: the cluster's capacity becomes ``N``
+                    (devices for training, replicas for serving) at
+                    step 5 — elastic drivers reshape to it; drivers
+                    with no reshape path skip it with a warning
+                    (``on_step`` never executes signal kinds)
 
 Timing contract: ``kill``-type faults (sigterm / kill / device_loss)
 fire in :meth:`ChaosInjector.on_step` — *after* step ``k``'s dispatch
 returns and *before* the step-``k`` checkpoint decision, so the state
 of step ``k`` is never durable at death (maximum honest replay).
 ``nan_grad`` is pre-step by nature: :meth:`ChaosInjector.poison_batch`
-rewrites the batch consumed by step ``k`` itself.
+rewrites the batch consumed by step ``k`` itself.  SIGNAL kinds
+(``traffic_spike`` / ``capacity_change``) have no default action —
+elastic-aware drivers consume them post-step through :meth:`take`,
+which journals exactly like a fired kill (one-shot across relaunches,
+same replay semantics) *before* the driver acts on the signal.
 
 One-shot across relaunches: a resumed process replays the armed step
 index, so a fault that re-fired would preempt the run forever.  Fired
@@ -59,7 +82,16 @@ from dataclasses import dataclass
 
 log = logging.getLogger(__name__)
 
-KINDS = ("sigterm", "kill", "nan_grad", "device_loss")
+KINDS = (
+    "sigterm", "kill", "nan_grad", "device_loss",
+    "traffic_spike", "capacity_change",
+)
+# kinds with no default action: on_step never executes them; elastic
+# drivers poll them via ChaosInjector.take (same journal semantics)
+SIGNAL_KINDS = ("traffic_spike", "capacity_change")
+# kinds that accept the optional ``:<arg>`` suffix (burst size /
+# target capacity); every other kind rejects one at parse time
+ARG_KINDS = ("traffic_spike", "capacity_change")
 CHAOS_ENV = "DDL25_CHAOS"
 FIRED_BASENAME = "chaos_fired.jsonl"
 
@@ -75,10 +107,14 @@ class DeviceLossError(RuntimeError):
 class Fault:
     kind: str
     step: int
+    # the optional ``:<arg>`` payload (traffic_spike burst size /
+    # capacity_change target size); None when the spec omitted it
+    arg: int | None = None
 
     @property
     def key(self) -> str:
-        return f"{self.kind}@{self.step}"
+        base = f"{self.kind}@{self.step}"
+        return base if self.arg is None else f"{base}:{self.arg}"
 
 
 def parse_chaos(spec: str | None) -> tuple[Fault, ...]:
@@ -95,7 +131,7 @@ def parse_chaos(spec: str | None) -> tuple[Fault, ...]:
         kind, sep, step_s = entry.partition("@")
         if not sep or not step_s:
             raise ValueError(
-                f"chaos entry {entry!r} is not <kind>@<step> "
+                f"chaos entry {entry!r} is not <kind>@<step>[:<arg>] "
                 f"(spec {spec!r})"
             )
         if kind not in KINDS:
@@ -103,6 +139,25 @@ def parse_chaos(spec: str | None) -> tuple[Fault, ...]:
                 f"chaos kind {kind!r} is not one of {sorted(KINDS)} "
                 f"(spec {spec!r})"
             )
+        step_s, asep, arg_s = step_s.partition(":")
+        arg: int | None = None
+        if asep:
+            if kind not in ARG_KINDS:
+                raise ValueError(
+                    f"chaos kind {kind!r} takes no :<arg> suffix "
+                    f"(entry {entry!r}); arg kinds: {sorted(ARG_KINDS)}"
+                )
+            try:
+                arg = int(arg_s)
+            except ValueError:
+                raise ValueError(
+                    f"chaos arg {arg_s!r} is not an integer "
+                    f"(entry {entry!r})"
+                ) from None
+            if arg < 1:
+                raise ValueError(
+                    f"chaos arg must be >= 1, got {arg} (entry {entry!r})"
+                )
         try:
             step = int(step_s)
         except ValueError:
@@ -111,7 +166,7 @@ def parse_chaos(spec: str | None) -> tuple[Fault, ...]:
             ) from None
         if step < 0:
             raise ValueError(f"chaos step must be >= 0, got {step}")
-        faults.append(Fault(kind, step))
+        faults.append(Fault(kind, step, arg))
     return tuple(faults)
 
 
@@ -200,7 +255,10 @@ class ChaosInjector:
                 os.fsync(f.fileno())
         from ddl25spring_tpu.obs.recorder import flight
 
-        flight.record(kind="chaos", fault=fault.kind, step=fault.step)
+        flight.record(
+            kind="chaos", fault=fault.kind, step=fault.step,
+            **({"arg": fault.arg} if fault.arg is not None else {}),
+        )
 
     # ---- pre-step: data poisoning ---------------------------------------
 
@@ -239,13 +297,48 @@ class ChaosInjector:
                 )
         return out if poisoned[0] else batch
 
+    # ---- post-step: signal kinds (polled, never executed) ---------------
+
+    def take(
+        self, step: int, kinds: tuple[str, ...] = SIGNAL_KINDS
+    ) -> tuple[Fault, ...]:
+        """Consume armed faults of ``kinds`` for ``step`` WITHOUT
+        executing any default action: the elastic-driver entry
+        (``traffic_spike`` / ``capacity_change``, and ``device_loss``
+        when the driver reshapes instead of dying).  Each taken fault
+        is journaled + flight-recorded exactly like a fired kill —
+        BEFORE the caller acts on it, so a death mid-reshape never
+        re-fires the signal on replay."""
+        taken = tuple(
+            f for f in self.pending()
+            if f.step == step and f.kind in kinds
+        )
+        for f in taken:
+            self._mark_fired(f)
+            log.warning("chaos: %s taken (signal)", f.key)
+        return taken
+
     # ---- post-step: kill-type faults ------------------------------------
 
-    def on_step(self, step: int) -> None:
+    def on_step(self, step: int, skip: tuple[str, ...] = ()) -> None:
         """Fire any armed kill-type fault for ``step`` (called after the
-        step's dispatch returns; see the module timing contract)."""
+        step's dispatch returns; see the module timing contract).
+        SIGNAL kinds are skipped — they exist for drivers that poll
+        :meth:`take`; a driver with no reshape path leaves them armed
+        and a one-time warning says so instead of a silent no-op.
+        ``skip`` names kinds the CALLER owns via :meth:`take` (an
+        elastic driver claims ``device_loss`` so the default
+        raise-and-die action never preempts its reshape)."""
         for f in self.pending():
-            if f.step != step or f.kind == "nan_grad":
+            if f.step != step or f.kind == "nan_grad" or f.kind in skip:
+                continue
+            if f.kind in SIGNAL_KINDS:
+                log.warning(
+                    "chaos: %s armed but this driver has no reshape "
+                    "path (signal kinds need an elastic driver — "
+                    "bench.py --elastic or the elastic serve phase); "
+                    "left armed, not executed", f.key,
+                )
                 continue
             self._mark_fired(f)
             if f.kind == "sigterm":

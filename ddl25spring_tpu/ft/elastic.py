@@ -1,0 +1,162 @@
+"""In-run elastic mesh reshaping: survive device loss without a restart.
+
+PR 6 made death survivable the expensive way: die, relaunch, restore
+from the last durable checkpoint — losing every step since it and the
+whole process bring-up (imports, backend dial, compile) on the wall
+clock.  This module is the cheap way (ROADMAP item 4): on a classified
+:class:`~ddl25spring_tpu.ft.chaos.DeviceLossError` or an explicit
+``capacity_change`` signal, the *running process* reshapes onto the
+surviving mesh and keeps going, losing at most the in-flight step.
+
+The reshape is three moves, none of them new machinery:
+
+1. **Snapshot device-to-device.**  The live train state is already in
+   hand (chaos fires post-step by contract, so the driver holds the
+   last completed step's pytree — the in-flight exposure is zero).
+   ZeRO ``[n, k]`` / ``[L, n, k]`` shard rows redistribute
+   through :mod:`ddl25spring_tpu.ft.reshard`'s zero-refit math onto the
+   survivor layout via ``device_put`` — the SAME exactness argument as
+   the checkpoint restore path (padding at the flat tail), but on live
+   ``jax.Array`` leaves through the no-host-copy fast path.  The orbax
+   checkpoint is never touched: it remains the backstop for real
+   process death, not the transport for a mesh change.
+
+2. **Re-lower the strategy.**  The PR-12 rule engine makes a strategy
+   *data* — mesh + rule table + discipline — so for ``*-rules``
+   strategies the re-lower is
+   :meth:`~ddl25spring_tpu.parallel.rules.RulePartitioner.with_mesh`
+   with the SAME table (new mesh axes); bespoke builders rebuild
+   through their existing ``describe()`` registry hooks
+   (:func:`relower`).  The survivor step's collective signature
+   re-pins under graft-lint/graft-shard exactly like a fresh build
+   (``tests/test_elastic.py``).
+
+3. **Resume mid-epoch from memory.**  The data cursor and rng seed —
+   the :func:`~ddl25spring_tpu.ft.autosave.resume_bundle` fields — are
+   live host state; no manifest read, no replay beyond the step that
+   was in flight.  A ``kind="reshape"`` flight event records old/new
+   mesh, wall clock, and steps lost, and
+   :meth:`~ddl25spring_tpu.ft.autosave.AutoSaver.note_reshape` drops
+   the stale leaf-shape cache so the next checkpoint records the new
+   layout (the following cross-mesh resume keys on it).
+
+Driven by the chaos kinds ``device_loss@k`` (promoted from "raise and
+die" to "raise and reshape" under ``bench.py --elastic``) and
+``capacity_change@k[:size]``, and judged by a hard A/B: the CI
+``elastic-smoke`` job runs the same ``device_loss@k`` spec through this
+path and the PR-6 checkpoint-relaunch path and requires the reshape to
+win on steps-lost (strictly) with both recovery wall clocks recorded in
+``telemetry.resume``.  The serving half of the same machinery lives in
+:func:`ddl25spring_tpu.serve.driver.elastic_serve_run` (replica
+scale-up/down with page-pool handoff).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+def surviving_devices(devices, *, lose: int = 0, size: int | None = None):
+    """The survivor slice after a capacity event: ``size`` devices when
+    an explicit target is given (``capacity_change@k:size``), else the
+    first ``len - lose`` (``device_loss``: the failed slice drops off
+    the end — which physical devices survive is the scheduler's call,
+    the math only needs *how many*).  Refuses an empty or growing-
+    beyond-available slice loudly."""
+    n = len(devices)
+    target = int(size) if size is not None else n - int(lose)
+    if not 0 < target <= n:
+        raise ValueError(
+            f"cannot reshape to {target} devices (have {n}; lose={lose}, "
+            f"size={size})"
+        )
+    return list(devices)[:target]
+
+
+def reshape_state(state: Any, template: Any) -> Any:
+    """Re-land a LIVE state pytree onto a new mesh's template —
+    :func:`ddl25spring_tpu.ft.reshard.reshard_state` with live leaves
+    (the device fast path), named separately because the elastic caller
+    is moving memory between meshes, not restoring a checkpoint.  The
+    template may be abstract (``zero_resume_template(abstract=True)``)
+    so the survivor never materializes a throwaway full state."""
+    from ddl25spring_tpu.ft import reshard
+
+    return reshard.reshard_state(state, template)
+
+
+def relower(strategy, mesh, **kw):
+    """Re-lower a strategy onto a new mesh — the step-rebuild half of a
+    reshape.
+
+    - a :class:`~ddl25spring_tpu.parallel.rules.RuleTable` or
+      :class:`~ddl25spring_tpu.parallel.rules.RulePartitioner`: the
+      table IS the strategy; rebind it to the survivor mesh and build
+      the train step through the one generic lowering path (``kw``
+      passes to ``make_train_step`` — ``loss_fn``, ``tx``,
+      ``params_template`` required);
+    - a registered strategy NAME: rebuild through the describe()
+      registry on the new mesh (returns the describe dict — the
+      canonical workload's step plus its signature/meta, which is what
+      the re-pin gates consume).
+    """
+    from ddl25spring_tpu.parallel.rules import RulePartitioner, RuleTable
+
+    if isinstance(strategy, RulePartitioner):
+        strategy = strategy.table
+    if isinstance(strategy, RuleTable):
+        part = RulePartitioner(mesh, strategy)
+        loss_fn = kw.pop("loss_fn")
+        tx = kw.pop("tx")
+        params_template = kw.pop("params_template")
+        return part.make_train_step(loss_fn, tx, params_template, **kw)
+    from ddl25spring_tpu.obs import xla_analytics
+
+    return xla_analytics.describe_strategy(str(strategy), mesh, **kw)
+
+
+def _mesh_cell(mesh_or_n) -> dict | int:
+    try:
+        return {
+            ax: int(s)
+            for ax, s in zip(mesh_or_n.axis_names, mesh_or_n.devices.shape)
+        }
+    except AttributeError:
+        return int(mesh_or_n)
+
+
+def record_reshape(
+    *,
+    old,
+    new,
+    wall_s: float,
+    steps_lost: int,
+    reason: str,
+    scope: str = "train",
+    **extra: Any,
+) -> dict:
+    """One ``kind="reshape"`` flight event + the driver-facing event
+    dict (what ``telemetry.resume.reshape`` / the serve reshape cell
+    carry).  ``old``/``new`` are meshes or plain device/replica counts;
+    ``reason`` names the trigger (``device_loss`` / ``capacity_change``
+    / ``traffic_spike``)."""
+    from ddl25spring_tpu.obs.recorder import flight
+
+    event = {
+        "scope": scope,
+        "reason": reason,
+        "old": _mesh_cell(old),
+        "new": _mesh_cell(new),
+        "wall_s": round(float(wall_s), 6),
+        "steps_lost": int(steps_lost),
+        **extra,
+    }
+    flight.record(kind="reshape", **event)
+    log.warning(
+        "elastic: %s reshape %s -> %s (%s) in %.3fs, %d step(s) lost",
+        scope, event["old"], event["new"], reason, wall_s, steps_lost,
+    )
+    return event

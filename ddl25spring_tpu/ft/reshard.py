@@ -32,6 +32,18 @@ as a fresh run would (``zero.zero_resume_template`` /
 ``checkpoint.with_mesh_placement``), and every restored leaf comes back
 carrying the template leaf's ``NamedSharding`` — the resumed ``jit``
 sees placements indistinguishable from a run that never died.
+
+Two sources, one rule (PR 14): the original consumer is the
+checkpoint-restore path (numpy leaves read off disk), but the elastic
+reshape path (:mod:`ddl25spring_tpu.ft.elastic`) hands this module
+*live jax arrays* straight off the dying mesh.  Live leaves take a
+**device fast path**: the refit runs as jax ops (``reshape`` /
+``slice`` / pad) and lands via ``device_put`` — no host copy per leaf.
+The one host transfer the fast path ever makes is the dropped TAIL of
+a shrinking leaf (a handful of padding elements), because the
+nonzero-truncation refusal is part of the contract, not an
+optimization to skip.  ``tests/test_elastic.py`` pins the fast path
+bitwise-equal to the host copy path.
 """
 
 from __future__ import annotations
@@ -39,6 +51,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 # The checkpoint layout contract, as data: which dimension of a saved
@@ -76,6 +89,28 @@ def _refit_flat(flat: np.ndarray, target_len: int, name: str) -> np.ndarray:
     return out
 
 
+def _refit_flat_live(flat, target_len: int, name: str):
+    """The device twin of :func:`_refit_flat`: zero-pad or zero-truncate
+    a flattened jax buffer without a host round-trip of the payload.
+    Truncation still host-reads the DROPPED tail (tiny — it is padding
+    when the layouts agree) because the nonzero-casualty refusal is
+    part of the contract, same-ordered and same-worded as the copy
+    path's."""
+    if flat.size == target_len:
+        return flat
+    if flat.size > target_len:
+        dropped = np.asarray(flat[target_len:])  # tail only, not the leaf
+        if np.any(dropped != 0):
+            raise ValueError(
+                f"cross-mesh refit of {name}: {flat.size} -> {target_len} "
+                f"elements would drop {int(np.count_nonzero(dropped))} "
+                "nonzero values — the template's shard layout is smaller "
+                "than the saved parameter (mismatched model?)"
+            )
+        return flat[:target_len]
+    return jnp.pad(flat, (0, target_len - flat.size))
+
+
 def reshard_leaf(saved, template, name: str = "<leaf>"):
     """Refit one saved leaf onto one template leaf's shape + placement.
 
@@ -88,32 +123,63 @@ def reshard_leaf(saved, template, name: str = "<leaf>"):
 
     The result lands with the template leaf's sharding when it has one
     (host arrays / ShapeDtypeStructs without shardings stay host-side).
+
+    A *live* ``jax.Array`` source takes the device fast path: the refit
+    stays in jax ops and ``device_put`` moves device-to-device, so an
+    elastic reshape never pays a host copy per leaf (module docstring;
+    pinned equal to the host path in ``tests/test_elastic.py``).
     """
-    arr = np.asarray(saved)
+    live = isinstance(saved, jax.Array)
+    arr = saved if live else np.asarray(saved)
+    refit = _refit_flat_live if live else _refit_flat
     tshape = tuple(template.shape)
     tdtype = np.dtype(template.dtype)
-    if arr.shape == tshape:
-        out = arr.astype(tdtype, copy=False)
+    if tuple(arr.shape) == tshape:
+        out = arr
     elif arr.ndim == 2 and len(tshape) == 2:
-        out = _refit_flat(
-            arr.reshape(-1), int(np.prod(tshape)), name
-        ).reshape(tshape).astype(tdtype, copy=False)
+        out = refit(arr.reshape(-1), int(np.prod(tshape)), name).reshape(
+            tshape
+        )
     elif arr.ndim == 3 and len(tshape) == 3 and arr.shape[0] == tshape[0]:
         L = arr.shape[0]
         rows = int(np.prod(tshape[1:]))
-        out = np.stack(
-            [_refit_flat(arr[i].reshape(-1), rows, f"{name}[layer {i}]")
-             for i in range(L)]
-        ).reshape(tshape).astype(tdtype, copy=False)
+        if live:
+            # vectorized over layers: one reshape/pad-or-slice for the
+            # whole [L, n, k] stack instead of a per-layer host walk
+            # (padding sits at each layer's flat TAIL, so the batched
+            # refit below is elementwise-identical to per-layer)
+            flat = arr.reshape(L, -1)
+            if flat.shape[1] > rows:
+                dropped = np.asarray(flat[:, rows:])
+                if np.any(dropped != 0):
+                    raise ValueError(
+                        f"cross-mesh refit of {name}: "
+                        f"{flat.shape[1]} -> {rows} elements/layer would "
+                        f"drop {int(np.count_nonzero(dropped))} nonzero "
+                        "values — the template's shard layout is smaller "
+                        "than the saved parameter (mismatched model?)"
+                    )
+                flat = flat[:, :rows]
+            elif flat.shape[1] < rows:
+                flat = jnp.pad(flat, ((0, 0), (0, rows - flat.shape[1])))
+            out = flat.reshape(tshape)
+        else:
+            out = np.stack(
+                [refit(arr[i].reshape(-1), rows, f"{name}[layer {i}]")
+                 for i in range(L)]
+            ).reshape(tshape)
     else:
         raise ValueError(
-            f"cannot reshard {name}: saved shape {arr.shape} does not map "
-            f"onto template shape {tshape} (rank/leading-dim mismatch)"
+            f"cannot reshard {name}: saved shape {tuple(arr.shape)} does "
+            f"not map onto template shape {tshape} (rank/leading-dim "
+            "mismatch)"
         )
+    if out.dtype != tdtype:
+        out = out.astype(tdtype)
     sharding = getattr(template, "sharding", None)
     if sharding is not None:
         return jax.device_put(out, sharding)
-    return out
+    return np.asarray(out) if live else out
 
 
 def reshard_state(saved_tree: Any, template_tree: Any) -> Any:

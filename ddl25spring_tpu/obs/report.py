@@ -213,9 +213,20 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 ("save_skipped", "saves_skipped"),
                 ("restore", "restores"),
                 ("chaos", "chaos_faults"),
+                # elastic in-run reshapes (ft/elastic.py): RECOVERY
+                # events, not violations — the health gate reports
+                # them informationally and never fails on them
+                ("reshape", "reshapes"),
             ):
                 if counts.get(kind):
                     recovery[label] = counts[kind]
+            if counts.get("reshape"):
+                reshape_recs = [
+                    r for r in fl.get("records") or []
+                    if r.get("kind") == "reshape"
+                ]
+                if reshape_recs:
+                    recovery["last_reshape"] = reshape_recs[-1]
             if recovery:
                 out["recovery"] = recovery
         except (json.JSONDecodeError, OSError) as e:
@@ -281,6 +292,7 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 "ab": sdoc.get("ab"),
                 "prefix_ab": sdoc.get("prefix_ab"),
                 "spec_ab": sdoc.get("spec_ab"),
+                "reshape": sdoc.get("reshape"),
                 "git_sha": sdoc.get("git_sha"),
             }
         except (json.JSONDecodeError, OSError) as e:
@@ -521,6 +533,21 @@ def format_report(summary: dict[str, Any]) -> str:
                     f"{sab.get('advantage_tokens')}, tokens match "
                     f"{sab.get('tokens_match')})"
                 )
+            rsh = sv.get("reshape")
+            if rsh:
+                evs = rsh.get("events") or []
+                p95r = rsh.get("ttft_s_p95_reshape")
+                p95s = rsh.get("ttft_s_p95_steady")
+                lines.append(
+                    f"  elastic reshape: {len(evs)} event(s) "
+                    + " ".join(
+                        f"[{e.get('reason')} {e.get('old')}->"
+                        f"{e.get('new')}]" for e in evs
+                    )
+                    + f"  dropped {rsh.get('dropped_requests')}"
+                    + f"  TTFT p95 window {sms(p95r)} vs steady "
+                    f"{sms(p95s)}"
+                )
 
     c = summary.get("counters", {})
     statics = c.get("static", {})
@@ -598,6 +625,19 @@ def format_report(summary: dict[str, Any]) -> str:
                 f"  resumed from step {rec['resumed_from_step']}"
                 + (f"  ({replay} step(s) replayed)"
                    if replay is not None else "")
+            )
+        if rec.get("reshapes"):
+            last = rec.get("last_reshape") or {}
+            lines.append(
+                f"  elastic reshapes: {rec['reshapes']} (recovery "
+                "events, not violations)"
+                + (
+                    f"  last: {last.get('old')} -> {last.get('new')} "
+                    f"({last.get('reason')}, "
+                    f"{last.get('steps_lost')} step(s) lost, "
+                    f"{last.get('wall_s')} s)"
+                    if last else ""
+                )
             )
         counts_bits = [
             f"{k}={rec[k]}"

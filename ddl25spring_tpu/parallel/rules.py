@@ -261,6 +261,16 @@ class RulePartitioner(Partitioner):
     def mesh(self):
         return self._mesh
 
+    def with_mesh(self, mesh) -> "RulePartitioner":
+        """Re-lower seam for elastic reshaping (PR 14,
+        :mod:`ddl25spring_tpu.ft.elastic`): the SAME table on a
+        different mesh.  Because a strategy is data, surviving a
+        device loss is not a new module — it is this one-line rebind
+        plus a :meth:`make_train_step` on the survivor mesh; the
+        table's coverage proof (H012) and issue discipline carry over
+        unchanged."""
+        return RulePartitioner(mesh, self.table)
+
     def layout_of(self, params_template) -> str:
         """The table's (homogeneous) layout for this param tree; the
         coverage walk runs first so an unsound table fails here with

@@ -729,6 +729,7 @@ def zero_resume_template(
     mesh: Mesh,
     axis: str = "data",
     llama: bool = False,
+    abstract: bool = False,
 ):
     """The restore template for a (possibly cross-mesh) ZeRO resume:
     ``{"params": shards, "opt_state": tx.init(shards)}`` laid out for
@@ -742,16 +743,49 @@ def zero_resume_template(
     saved ``[n, k]`` shard onto this template's ``[m, k']`` layout
     through :mod:`ddl25spring_tpu.ft.reshard` — the elastic half of the
     weight-update-sharding math (arXiv:2004.13336) this module's
-    forward/backward implements."""
+    forward/backward implements.
+
+    ``abstract=True`` returns sharding-carrying ``ShapeDtypeStruct``
+    leaves instead of materialized zeros — the elastic in-run reshape
+    (:mod:`ddl25spring_tpu.ft.elastic`) templates with it so the
+    survivor mesh never allocates a throwaway full state right when a
+    device just died and memory headroom is at its worst.  Shapes come
+    from ``jax.eval_shape`` over the SAME shard+init path the concrete
+    template runs; shardings follow the saved-layout contract
+    (:data:`ddl25spring_tpu.ft.reshard.SAVED_SHARD_DIMS`: rank 2 ->
+    rows on dim 0, rank 3 -> dim 1, anything else replicated — the
+    layout H013 verifies at compile time)."""
     from ddl25spring_tpu.utils.checkpoint import with_mesh_placement
 
-    shards = (
-        zero_shard_llama_params(params_template, mesh, axis)
-        if llama else zero_shard_params(params_template, mesh, axis)
+    shard = zero_shard_llama_params if llama else zero_shard_params
+    if not abstract:
+        shards = shard(params_template, mesh, axis)
+        return with_mesh_placement(
+            {"params": shards, "opt_state": tx.init(shards)}, mesh
+        )
+
+    from ddl25spring_tpu.ft.reshard import SAVED_SHARD_DIMS
+
+    n = mesh.shape[axis]
+    abs_tree = jax.eval_shape(
+        lambda p: (lambda s: {"params": s, "opt_state": tx.init(s)})(
+            shard(p, mesh, axis)
+        ),
+        params_template,
     )
-    return with_mesh_placement(
-        {"params": shards, "opt_state": tx.init(shards)}, mesh
-    )
+
+    def place(leaf):
+        dim = SAVED_SHARD_DIMS.get(len(leaf.shape))
+        spec = (
+            P(*([None] * dim + [axis]))  # trailing dims unsharded
+            if dim is not None and leaf.shape[dim] == n
+            else P()
+        )
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    return jax.tree.map(place, abs_tree)
 
 
 def make_zero3_llama_train_step(

@@ -348,6 +348,254 @@ def spec_ab_compare(
     return out
 
 
+def elastic_serve_run(
+    params, cfg, trace, knobs: dict[str, Any], *,
+    chaos, tick_s: float | None = None, replicas: int = 2,
+    max_replicas: int = 4, max_iters: int = 20_000,
+    temperature: float = 0.0, sentinel: bool | None = None,
+    keep_requests: bool = False,
+) -> dict[str, Any]:
+    """Replica scale-up/down under live traffic with page-pool handoff
+    (PR 14: the serving half of :mod:`ddl25spring_tpu.ft.elastic`).
+
+    A replica set of continuous-batching engines runs the seeded trace
+    in lockstep on ONE driver virtual clock (each iteration steps every
+    active replica, then advances ``tick_s`` — deterministic on any
+    host).  Arrivals route to the shortest non-draining queue.  The
+    armed chaos faults (consumed through ``chaos.take`` at exact
+    iteration indices, one-shot journal semantics identical to the
+    training kinds) drive three event shapes:
+
+    - ``traffic_spike@k[:B]`` — B deterministic extra arrivals (the
+      trace's own first B requests, re-stamped to now) land at once;
+      the queue-depth autoscaler answers with a scale-up when the
+      backlog crosses 2x the per-replica slot count;
+    - ``capacity_change@k[:N]`` — the set resizes to N replicas (grow:
+      fresh engines; shrink: drain);
+    - ``device_loss@k`` — one replica is lost: it stops admitting, its
+      unadmitted queue re-submits to the survivors
+      (:meth:`~ddl25spring_tpu.serve.engine.ServeEngine.begin_drain` —
+      queued requests hold no pages, so the handoff is a plain
+      re-submit), its live slots decode to completion through the
+      ordinary release discipline, and only then does its page pool go
+      away.  An accepted request can therefore never be lost; the
+      ``--check-reshape`` gate pins ``dropped_requests == 0``.
+
+    Every event lands as a ``kind="reshape"`` flight record
+    (:func:`ddl25spring_tpu.ft.elastic.record_reshape`) and in the
+    returned cell, which also splits TTFT into the reshape windows
+    (event start -> drain end + a small settling pad) vs steady state —
+    the p95-bounded comparison ``serve_report --check-reshape`` gates.
+    """
+    from ddl25spring_tpu.ft import elastic
+    from ddl25spring_tpu.serve.engine import Request
+
+    if tick_s is None:
+        tick_s = ab_tick_s(trace, knobs["max_slots"])
+    elastic_kinds = ("traffic_spike", "capacity_change", "device_loss")
+
+    def build():
+        e = _build_engine(
+            params, cfg, knobs, admission="continuous", clock="virtual",
+            tick_s=tick_s, temperature=temperature, sentinel=sentinel,
+            prefill_batch=knobs["max_slots"],
+        )
+        return e
+
+    reps = [build() for _ in range(replicas)]
+    retired: list = []
+    draining: list[tuple[Any, dict]] = []
+    arrivals = sorted(trace, key=lambda r: r["t"])
+    events: list[dict] = []
+    rid = 0
+    t = 0.0
+    i = it = 0
+    submitted = 0
+    spike_backlog: list[dict] = []
+
+    def route(req: Request, force: bool = False) -> None:
+        """Shortest-queue routing.  ``force`` is the handoff path: a
+        request a draining replica already ACCEPTED must re-admit even
+        if the survivors' door policy (queue_full / token_budget) would
+        bounce a NEW arrival — it was validated once and the zero-drop
+        contract outranks the bound, so a rejected re-submit is seated
+        directly in the shortest queue (the transient overflow is the
+        honest cost of losing a replica)."""
+        live = [e for e in reps if not e.draining]
+        target = min(live, key=lambda e: (len(e.queue), reps.index(e)))
+        if force:
+            # no second trip through the door: the original submit()
+            # validated it, and a counted rejection here would skew the
+            # admission arithmetic for a request that then completes
+            target.queue.append(req)
+        else:
+            target.submit(req)
+
+    def mk(a: dict, arrival_t: float) -> Request:
+        nonlocal rid, submitted
+        r = Request(
+            rid=rid, prompt=list(map(int, a["prompt"])),
+            max_new_tokens=int(a["max_new"]), arrival_t=arrival_t,
+        )
+        rid += 1
+        submitted += 1
+        return r
+
+    def scale_up(n_new: int, reason: str) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        old = len(reps)
+        for _ in range(n_new):
+            reps.append(build())
+        ev = elastic.record_reshape(
+            scope="serve", reason=reason, old=old, new=len(reps),
+            wall_s=_time.perf_counter() - t0, steps_lost=0, t=round(t, 6),
+        )
+        ev["t_end"] = round(t, 6)  # a fresh replica serves immediately
+        events.append(ev)
+
+    def scale_down(n_drop: int, reason: str) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        old = len(reps)
+        victims = [e for e in reversed(reps) if not e.draining][:n_drop]
+        requeued = 0
+        for v in victims:
+            for req in v.begin_drain():
+                route(req, force=True)
+                requeued += 1
+        ev = elastic.record_reshape(
+            scope="serve", reason=reason, old=old,
+            new=old - len(victims), wall_s=_time.perf_counter() - t0,
+            steps_lost=0, t=round(t, 6), requeued=requeued,
+        )
+        events.append(ev)
+        draining.extend((v, ev) for v in victims)
+
+    while True:
+        # arrivals whose time has come (plus any spike burst), routed
+        # to the shortest live queue
+        while i < len(arrivals) and arrivals[i]["t"] <= t:
+            route(mk(arrivals[i], arrivals[i]["t"]))
+            i += 1
+        for a in spike_backlog:
+            route(mk(a, t))
+        spike_backlog = []
+
+        # chaos at this iteration (journaled BEFORE acting, like every
+        # chaos fire — a death mid-reshape never replays the signal)
+        for f in chaos.take(it, kinds=elastic_kinds):
+            if f.kind == "traffic_spike":
+                burst = f.arg or max(4, len(arrivals) // 8)
+                spike_backlog.extend(  # += : same-step bursts stack
+                    [dict(a) for a in arrivals[:burst]]
+                    or [{"prompt": [1, 2], "max_new": 4}] * burst
+                )
+            elif f.kind == "capacity_change":
+                target = f.arg or 1
+                live = sum(1 for e in reps if not e.draining)
+                grow = max(0, min(target, max_replicas) - live)
+                if grow:
+                    scale_up(grow, "capacity_change")
+                elif target < live:
+                    scale_down(live - target, "capacity_change")
+            elif f.kind == "device_loss":
+                if sum(1 for e in reps if not e.draining) > 1:
+                    scale_down(1, "device_loss")
+
+        # queue-depth autoscaler: the traffic_spike response (half of
+        # "traffic-driven autoscaling" — the spike injects the load,
+        # this reacts to it).  One replica per decision, with a
+        # settling cooldown so a burst scales once, not once per tick.
+        backlog = sum(len(e.queue) for e in reps if not e.draining)
+        live_n = sum(1 for e in reps if not e.draining)
+        if (backlog > 2 * knobs["max_slots"] and live_n < max_replicas
+                and (not events or t - events[-1]["t"] > 10 * tick_s)):
+            scale_up(1, "traffic_spike_scale_up")
+
+        # one lockstep tick: every replica sees the SAME driver clock
+        ran = False
+        for e in list(reps):
+            e._vtime = t  # lockstep: one driver clock for every replica
+            ran = e.step() or ran
+        for v, ev in list(draining):
+            if v.drained:
+                ev["t_end"] = round(t, 6)
+                ev["drained_slots"] = v.max_slots
+                reps.remove(v)
+                retired.append(v)
+                draining.remove((v, ev))
+        t += tick_s
+        it += 1
+        done_feeding = i >= len(arrivals) and not spike_backlog
+        idle = not ran and all(
+            not e.queue and all(s is None for s in e.slots) for e in reps
+        )
+        if (done_feeding and idle and not draining) or it >= max_iters:
+            break
+
+    # ---- the reshape cell: windows, drops, percentiles ----------------
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        k = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+        return xs[k]
+
+    pad = 5 * tick_s  # settling margin after a drain completes
+    windows = [
+        (ev["t"], ev.get("t_end", ev["t"]) + pad) for ev in events
+    ]
+
+    def in_window(x: float) -> bool:
+        return any(a <= x <= b for a, b in windows)
+
+    all_done = [r for e in [*reps, *retired] for r in e.done]
+    ttft_window = [
+        r.first_token_t - r.arrival_t for r in all_done
+        if r.first_token_t is not None and in_window(r.first_token_t)
+    ]
+    ttft_steady = [
+        r.first_token_t - r.arrival_t for r in all_done
+        if r.first_token_t is not None and not in_window(r.first_token_t)
+    ]
+    admitted = sum(e.admitted for e in [*reps, *retired])
+    completed = sum(e.completed for e in [*reps, *retired])
+    rejected = sum(
+        sum(e.rejected.values()) for e in [*reps, *retired]
+    )
+    return {
+        "events": events,
+        "tick_s": tick_s,
+        "iters": it,
+        "wall_virtual_s": round(t, 6),
+        "replicas_start": replicas,
+        "replicas_end": len(reps),
+        "max_replicas": max_replicas,
+        "submitted": submitted,
+        "admitted": admitted,
+        "completed": completed,
+        "rejected": rejected,
+        # accepted-then-lost across every handoff: the zero the
+        # --check-reshape gate pins (run-to-drain makes it exact)
+        "dropped_requests": admitted - completed,
+        "generated_tokens": sum(
+            e.generated_tokens for e in [*reps, *retired]
+        ),
+        "ttft_s_p50_steady": pct(ttft_steady, 50),
+        "ttft_s_p95_steady": pct(ttft_steady, 95),
+        "ttft_s_p50_reshape": pct(ttft_window, 50),
+        "ttft_s_p95_reshape": pct(ttft_window, 95),
+        "reshape_window_requests": len(ttft_window),
+        "steady_requests": len(ttft_steady),
+        # test hook only (the token-exactness pin): never serialized —
+        # run_serve_bench does not pass keep_requests
+        **({"_requests": all_done} if keep_requests else {}),
+    }
+
+
 def run_serve_bench(
     *,
     smoke: bool = False,
@@ -452,6 +700,33 @@ def run_serve_bench(
             params, cfg, trace, knobs, sentinel=sentinel,
         )
 
+    # --- elastic replica reshaping (PR 14): armed chaos only ----------
+    # DDL25_CHAOS=traffic_spike@k / capacity_change@k:N / device_loss@k
+    # drives replica scale-up/down with page-pool handoff on the
+    # deterministic driver clock; the reshape cell (events, TTFT
+    # windows, zero-drop proof) is what --check-reshape gates.  The
+    # spec engine path is excluded for now (two pools per replica —
+    # the handoff story is the same, the bookkeeping is ROADMAP work).
+    reshape = None
+    from ddl25spring_tpu.ft.chaos import ChaosInjector
+
+    chaos = ChaosInjector.from_env(state_dir=obs_dir)
+    elastic_armed = chaos.pending("traffic_spike") + chaos.pending(
+        "capacity_change"
+    ) + chaos.pending("device_loss")
+    if elastic_armed and not knobs.get("spec_k"):
+        reshape = elastic_serve_run(
+            params, cfg, trace, knobs, chaos=chaos,
+            temperature=temperature, sentinel=sentinel,
+        )
+    elif elastic_armed:
+        import warnings
+
+        warnings.warn(
+            "elastic serve reshaping skipped: speculative engines "
+            "(DDL25_SERVE_SPEC=1) are not covered yet", stacklevel=2,
+        )
+
     record: dict[str, Any] = {
         "record": "serve",
         "ts": time.time(),
@@ -482,6 +757,12 @@ def run_serve_bench(
                 "draft_layers": knobs["draft_layers"],
             } if knobs.get("spec_k") else {}),
             **({"max_new_jitter": jitter} if jitter else {}),
+            # an elastic run (replica reshaping armed) is a different
+            # measurement context than a plain ramp — keyed apart so
+            # --check-reshape's "latest row" can never be a plain run
+            # that legitimately carries no reshape cell (and, like the
+            # spec keys, absent on every pre-PR-14 row)
+            **({"elastic": True} if reshape is not None else {}),
             **({
                 "shared_prefixes": spec.shared_prefixes,
                 "shared_prefix_len": spec.shared_prefix_len,
@@ -493,6 +774,7 @@ def run_serve_bench(
         **({"ab": ab} if ab is not None else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab is not None else {}),
         **({"spec_ab": spec_ab} if spec_ab is not None else {}),
+        **({"reshape": reshape} if reshape is not None else {}),
         # bounded raw samples for serve_report's histogram (the summary
         # percentiles above are what the gates read)
         "ttft_s": [round(x, 6) for x in eng.ttft_s[:512]],
@@ -568,7 +850,37 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
     sab = record.get("spec_ab")
     if sab:
         out["spec_ab"] = _spec_ab_cell(sab)
+    rsh = record.get("reshape")
+    if rsh:
+        out["reshape"] = _reshape_cell(rsh)
     return out
+
+
+def _reshape_cell(rsh: dict[str, Any]) -> dict[str, Any]:
+    """The elastic-reshape summary both the ledger row and
+    telemetry.serve carry — what ``serve_report --check-reshape``
+    gates.  Events keep only their identity facts (full dicts live in
+    serve.json)."""
+    return {
+        "events": [
+            {
+                k: ev.get(k)
+                for k in ("reason", "old", "new", "t", "t_end",
+                          "requeued", "wall_s")
+            }
+            for ev in rsh.get("events") or []
+        ],
+        "replicas_start": rsh.get("replicas_start"),
+        "replicas_end": rsh.get("replicas_end"),
+        "dropped_requests": rsh.get("dropped_requests"),
+        "admitted": rsh.get("admitted"),
+        "completed": rsh.get("completed"),
+        "rejected": rsh.get("rejected"),
+        "ttft_s_p95_steady": rsh.get("ttft_s_p95_steady"),
+        "ttft_s_p95_reshape": rsh.get("ttft_s_p95_reshape"),
+        "reshape_window_requests": rsh.get("reshape_window_requests"),
+        "steady_requests": rsh.get("steady_requests"),
+    }
 
 
 def _prefix_ab_cell(pab: dict[str, Any]) -> dict[str, Any]:
@@ -671,6 +983,9 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
     sab = record.get("spec_ab")
     if sab:
         cell["spec_ab"] = _spec_ab_cell(sab)
+    rsh = record.get("reshape")
+    if rsh:
+        cell["reshape"] = _reshape_cell(rsh)
     for k in ("ledger", "ledger_error", "serve_json"):
         if record.get(k):
             cell[k] = record[k]

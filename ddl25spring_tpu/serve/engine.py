@@ -59,6 +59,7 @@ REJECT_TOKEN_BUDGET = "token_budget"
 REJECT_TOO_LONG = "too_long"
 REJECT_POOL_EXHAUSTED = "pool_exhausted"
 REJECT_BAD_REQUEST = "bad_request"  # empty prompt / non-positive max_new
+REJECT_DRAINING = "draining"  # elastic scale-down: replica admits nothing
 
 
 # ------------------------------------------------------ compiled programs
@@ -647,6 +648,12 @@ class ServeEngine:
             int(np.prod(x.shape)) for x in jax.tree.leaves(params)
         )
 
+        # elastic handoff state (PR 14): a draining replica admits
+        # nothing new and runs its live slots to completion through the
+        # ordinary release discipline; its unadmitted queue is handed
+        # back to the replica set for re-admission elsewhere
+        self.draining = False
+
         # host state
         self.queue: deque[Request] = deque()
         self.slots: list[Request | None] = [None] * max_slots
@@ -883,7 +890,12 @@ class ServeEngine:
         (queued), else the rejection reason (also counted)."""
         reason = None
         total = req.prompt_len + req.max_new_tokens
-        if req.prompt_len < 1 or req.max_new_tokens < 1:
+        if self.draining:
+            # a draining replica must never accumulate work it will
+            # not admit — the replica set routes around it, and a
+            # direct submit bounces with its own reason
+            reason = REJECT_DRAINING
+        elif req.prompt_len < 1 or req.max_new_tokens < 1:
             # an empty prompt would decode from the zero-initialized
             # logits buffer (a token the model never produced); reject
             # at the door rather than serve garbage
@@ -975,6 +987,8 @@ class ServeEngine:
         whole batch rides one static start-offset prefill variant;
         when the free set is short, LRU eviction of unpinned cached
         pages runs before backpressure."""
+        if self.draining:
+            return []  # elastic scale-down: finish live work, admit none
         if self.admission == "static" and any(
             r is not None for r in self.slots
         ):
@@ -1417,6 +1431,32 @@ class ServeEngine:
                 self._pending[slot] = []
         self._release_mask = [False] * self.max_slots
         self._pending_pages = [0] * self.max_slots
+
+    # ---- elastic handoff (PR 14) ---------------------------------------
+
+    def begin_drain(self) -> list[Request]:
+        """Start an elastic scale-down of THIS replica: stop admitting
+        (``_admittable`` returns nothing), pop every request still in
+        the host queue and return it for re-admission on the surviving
+        replicas.  Queued requests were never admitted — no tokens, no
+        pages — so the handoff is a plain re-submit; the live slots
+        keep decoding here until they complete through the ordinary
+        release discipline (``drained`` flips true), at which point the
+        replica's whole page pool goes away with it.  An
+        accepted-then-lost request is therefore impossible by
+        construction — the ``--check-reshape`` gate pins the count at
+        zero anyway."""
+        self.draining = True
+        handoff = list(self.queue)
+        self.queue.clear()
+        return handoff
+
+    @property
+    def drained(self) -> bool:
+        """True once a draining replica holds no live work: every slot
+        released and nothing queued (the queue was handed off at
+        ``begin_drain``; rejects-at-the-door keep it empty after)."""
+        return all(r is None for r in self.slots) and not self.queue
 
     def step(self) -> bool:
         """One scheduler iteration: flush releases, admit + prefill,

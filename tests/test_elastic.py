@@ -1,0 +1,659 @@
+"""Elastic mesh reshaping (``ddl25spring_tpu/ft/elastic``): survive
+device loss and capacity change without a restart.
+
+The central pins, per the PR-14 acceptance contract:
+
+- **kill-free reshape equivalence**: an 8-way ZeRO-3 run reshaped LIVE
+  onto 4 devices mid-run (no subprocess, no checkpoint round-trip) and
+  continued matches the uninterrupted 4-way run from the same seed
+  (tolerance-pinned like the PR-6 cross-mesh restore test), and the
+  4 -> 8 grow-back cycle holds too;
+- **live fast path == copy path**: :func:`ft.reshard.reshard_leaf` on
+  live ``jax.Array`` leaves (device refit, no per-leaf host copy) is
+  BITWISE the numpy checkpoint path, including the nonzero-truncation
+  refusal;
+- **signature re-pin**: the post-reshape step's collective signature
+  re-pins clean via the compile analytics on the surviving mesh, and
+  the rule-engine strategy stays graft-lint/graft-shard clean there
+  (the ``with_mesh`` re-lower carries the table unchanged);
+- **serve handoff**: replica scale-down drains through the ordinary
+  release discipline with ZERO accepted-then-lost requests and
+  token-exact output; the traffic-spike autoscaler answers a burst
+  with a scale-up; ``serve_report --check-reshape`` gates it all.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddl25spring_tpu.ft import (
+    ChaosInjector,
+    Fault,
+    elastic,
+    parse_chaos,
+    reshard,
+)
+from ddl25spring_tpu.parallel import zero
+from ddl25spring_tpu.parallel.rules import TABLES, RulePartitioner
+from ddl25spring_tpu.utils.mesh import make_mesh
+
+
+# ------------------------------------------------------------ chaos grammar
+
+
+def test_signal_kind_grammar_matrix():
+    """The PR-14 grammar extension: signal kinds parse with and without
+    the ``:<arg>`` suffix, key round-trips, and every malformed shape
+    refuses loudly (same matrix discipline as the PR-6 kinds)."""
+    assert parse_chaos("traffic_spike@8") == (Fault("traffic_spike", 8),)
+    assert parse_chaos("traffic_spike@8:16") == (
+        Fault("traffic_spike", 8, 16),
+    )
+    assert parse_chaos("capacity_change@5:4") == (
+        Fault("capacity_change", 5, 4),
+    )
+    assert parse_chaos("device_loss@3,capacity_change@5:2") == (
+        Fault("device_loss", 3), Fault("capacity_change", 5, 2),
+    )
+    # key round-trip: the one-shot journal stores exactly this string
+    assert Fault("capacity_change", 5, 4).key == "capacity_change@5:4"
+    assert Fault("traffic_spike", 8).key == "traffic_spike@8"
+    for bad in (
+        "sigterm@5:2",        # arg on a kill kind
+        "capacity_change@5:", # empty arg
+        "capacity_change@5:x",
+        "capacity_change@5:0",  # arg must be >= 1
+        "traffic_spike",        # no step
+        "traffic_spike@:4",
+    ):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+def test_take_journals_one_shot_and_on_step_skips_signals(tmp_path):
+    """Signal kinds never execute through on_step (a non-elastic driver
+    must not die on them); take() consumes them with the same one-shot
+    journal semantics as a fired kill, and the skip= filter lets an
+    elastic driver claim device_loss away from the raise-and-die
+    default."""
+    spec = "traffic_spike@2:8,capacity_change@2:4,device_loss@2"
+    ci = ChaosInjector(parse_chaos(spec), state_dir=tmp_path)
+    ci.on_step(2, skip=("device_loss",))  # signals skipped, loss claimed
+    assert len(ci.pending()) == 3  # nothing fired
+    taken = ci.take(2)  # default: the two signal kinds
+    assert sorted(f.kind for f in taken) == [
+        "capacity_change", "traffic_spike",
+    ]
+    assert taken[0].arg in (8, 4)
+    (loss,) = ci.take(2, kinds=("device_loss",))
+    assert loss.kind == "device_loss"
+    assert not ci.pending()
+    # one-shot across relaunches: a fresh injector on the same journal
+    ci2 = ChaosInjector(parse_chaos(spec), state_dir=tmp_path)
+    assert not ci2.pending()
+    assert ci2.take(2) == ()
+
+
+# ------------------------------------------------- live fast path == copy
+
+
+def test_live_fast_path_equals_copy_path():
+    """reshard_leaf on live jax arrays (device refit) lands BITWISE on
+    the numpy checkpoint path's output — shrink, grow, and the
+    layer-stacked [L, n, k] layout — and refuses nonzero truncation
+    with the same story."""
+    true = np.arange(1, 38, dtype=np.float32)
+    saved = np.zeros(40, np.float32)
+    saved[:37] = true
+    saved = saved.reshape(8, 5)
+    stacked = np.stack([saved, 2 * saved])
+    for src, tmpl in (
+        (saved, jnp.zeros((4, 10), jnp.float32)),    # shrink 8 -> 4
+        (saved, jnp.zeros((16, 3), jnp.float32)),    # grow 8 -> 16
+        (stacked, jnp.zeros((2, 4, 10), jnp.float32)),  # [L, n, k]
+        (saved, jnp.zeros((8, 5), jnp.float32)),     # same shape
+    ):
+        via_np = reshard.reshard_leaf(src, tmpl, "w")
+        via_dev = reshard.reshard_leaf(jnp.asarray(src), tmpl, "w")
+        assert isinstance(via_dev, jax.Array)
+        np.testing.assert_array_equal(
+            np.asarray(via_np), np.asarray(via_dev)
+        )
+    # the truncation refusal holds on the device path (the dropped tail
+    # is host-read and judged exactly like the copy path's)
+    with pytest.raises(ValueError, match="nonzero"):
+        reshard.reshard_leaf(jnp.asarray(saved), jnp.zeros((2, 10)), "w")
+    with pytest.raises(ValueError, match="nonzero"):
+        reshard.reshard_leaf(
+            jnp.asarray(stacked), jnp.zeros((2, 2, 10)), "b"
+        )
+    with pytest.raises(ValueError, match="cannot reshard"):
+        reshard.reshard_leaf(jnp.asarray(saved), jnp.zeros((40,)), "w")
+
+
+def test_zero_resume_template_abstract_matches_concrete(devices8):
+    """The allocation-free template (``abstract=True``) carries exactly
+    the concrete template's shapes, dtypes, and shardings — flat and
+    layer-stacked layouts both — so the elastic reshape can target it
+    without materializing a throwaway state."""
+    mesh4 = make_mesh(devices8[:4], data=4)
+    tx = optax.adam(1e-2)
+    for params, llama in (
+        ({"w1": jnp.ones((12, 20)), "b1": jnp.zeros((20,)),
+          "w2": jnp.ones((20, 4))}, False),
+        ({"blocks": {"wq": jnp.ones((3, 6, 5))},
+          "embed": jnp.ones((7, 4))}, True),
+    ):
+        t_abs = zero.zero_resume_template(
+            params, tx, mesh4, llama=llama, abstract=True
+        )
+        t_con = zero.zero_resume_template(params, tx, mesh4, llama=llama)
+        flat_a = jax.tree_util.tree_flatten_with_path(t_abs)[0]
+        flat_c = jax.tree_util.tree_flatten_with_path(t_con)[0]
+        assert len(flat_a) == len(flat_c)
+        for (pa, la), (_pc, lc) in zip(flat_a, flat_c):
+            assert isinstance(la, jax.ShapeDtypeStruct), pa
+            assert la.shape == lc.shape, pa
+            assert la.dtype == lc.dtype, pa
+            assert la.sharding.spec == lc.sharding.spec, pa
+
+
+# ------------------------------------------- kill-free reshape equivalence
+
+
+@pytest.fixture(scope="module")
+def zero_world(devices8):
+    """One compile each of the 8-way and 4-way ZeRO-3 steps plus the
+    shared batch stream — both reshape-equivalence tests and the
+    signature re-pin ride these two compiles."""
+    k0 = jax.random.PRNGKey(0)
+    params = {
+        "w1": jax.random.normal(jax.random.fold_in(k0, 0), (12, 20)) * 0.1,
+        "b1": jnp.zeros((20,)),
+        "w2": jax.random.normal(jax.random.fold_in(k0, 1), (20, 4)) * 0.1,
+    }
+
+    def loss_fn(p, batch, key):
+        del key
+        x, yb = batch
+        return jnp.mean(
+            (jnp.tanh(x @ p["w1"] + p["b1"]) @ p["w2"] - yb) ** 2
+        )
+
+    tx = optax.adam(1e-2)
+    mesh8 = make_mesh(devices8, data=8)
+    mesh4 = make_mesh(devices8[:4], data=4)
+    batches = [
+        (
+            jax.random.normal(jax.random.fold_in(k0, 10 + i), (16, 12)),
+            jax.random.normal(jax.random.fold_in(k0, 20 + i), (16, 4)),
+        )
+        for i in range(4)
+    ]
+    world = {
+        "params": params, "loss_fn": loss_fn, "tx": tx,
+        "mesh8": mesh8, "mesh4": mesh4, "batches": batches,
+        "key": jax.random.PRNGKey(1),
+        "step8": zero.make_zero_dp_train_step(
+            loss_fn, tx, mesh8, params, per_shard_rng=False
+        ),
+        "step4": zero.make_zero_dp_train_step(
+            loss_fn, tx, mesh4, params, per_shard_rng=False
+        ),
+    }
+    # the oracle: 4 uninterrupted steps on the 4-way mesh (ZeRO's math
+    # is mesh-size-independent, so every elastic trajectory must land
+    # here no matter which meshes it visited in between)
+    s, o = zero.zero_shard_params(params, mesh4), None
+    o = tx.init(s)
+    for b in batches:
+        s, o, _ = world["step4"](s, o, b, world["key"])
+    world["p_ref"] = zero.zero_unshard_params(s, params)
+    return world
+
+
+def _run_elastic(world, first_mesh, first_step, second_mesh, second_step):
+    """Two steps on one mesh, a LIVE in-run reshape (no checkpoint, no
+    subprocess), two steps on the other; returns unsharded params."""
+    w = world
+    s = zero.zero_shard_params(w["params"], first_mesh)
+    o = w["tx"].init(s)
+    for b in w["batches"][:2]:
+        s, o, _ = first_step(s, o, b, w["key"])
+    tmpl = zero.zero_resume_template(
+        w["params"], w["tx"], second_mesh, abstract=True
+    )
+    state = elastic.reshape_state(
+        {"params": s, "opt_state": o},
+        {"params": tmpl["params"], "opt_state": tmpl["opt_state"]},
+    )
+    s, o = state["params"], state["opt_state"]
+    # the reshaped leaves carry the target mesh's layout exactly
+    lead = second_mesh.shape["data"]
+    assert s["w1"].shape[0] == lead
+    assert s["w1"].sharding.spec == jax.tree.leaves(
+        tmpl["params"]
+    )[0].sharding.spec
+    for b in w["batches"][2:]:
+        s, o, _ = second_step(s, o, b, w["key"])
+    return zero.zero_unshard_params(s, w["params"]), (s, o)
+
+
+def test_reshape_8_to_4_matches_uninterrupted(zero_world, devices8):
+    """The kill-free half of the PR-6 cross-mesh pin: 8 -> 4 mid-run
+    via the LIVE device-to-device path (abstract template, no orbax)
+    followed by the remaining steps matches the uninterrupted 4-way
+    run — same tolerance as the checkpointed twin, with a reshape
+    flight event recorded."""
+    from ddl25spring_tpu.obs import flight
+
+    w = zero_world
+    before = flight.counts().get("reshape", 0)
+    p_res, (s4, o4) = _run_elastic(
+        w, w["mesh8"], w["step8"], w["mesh4"], w["step4"]
+    )
+    ev = elastic.record_reshape(
+        old=w["mesh8"], new=w["mesh4"], wall_s=0.01, steps_lost=0,
+        reason="device_loss",
+    )
+    assert ev["old"] == {"data": 8} and ev["new"] == {"data": 4}
+    assert flight.counts().get("reshape", 0) == before + 1
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        p_res, w["p_ref"],
+    )
+
+    # the acceptance contract's last clause: the post-reshape step's
+    # collective signature re-pins clean on the surviving mesh (same
+    # expected shape as zero.describe(stage=3), like the PR-6 test)
+    from ddl25spring_tpu.obs import xla_analytics as xa
+    from ddl25spring_tpu.parallel import bucketing
+
+    n = 4
+    padded = sum(
+        n * (-(-int(np.prod(leaf.shape) or 1) // n)) * 4
+        for leaf in jax.tree.leaves(w["params"])
+    )
+    launches = zero._row_plan(
+        w["params"], n, bucketing.DEFAULT_BUCKET_BYTES
+    ).n_buckets
+    compiled = w["step4"].lower(
+        s4, o4, w["batches"][-1], w["key"]
+    ).compile()
+    rep = xa.analyze_compiled(compiled, w["mesh4"])
+    expected = {
+        "scalar_bytes": 64,
+        "all-gather": {
+            "min_bytes": padded, "max_bytes": 2 * padded + 256,
+            "axes": ["data"],
+            "min_count": launches, "max_count": 2 * launches,
+        },
+        "reduce-scatter": {
+            "min_bytes": padded // n, "max_bytes": padded // n + 256,
+            "axes": ["data"],
+            "min_count": launches, "max_count": launches,
+        },
+        "all-reduce": {"max_bytes": 64},
+        "forbidden": ["collective-permute", "all-to-all"],
+    }
+    assert xa.check_signature(rep, expected) == []
+
+
+def test_grow_back_4_to_8_matches_uninterrupted(zero_world):
+    """The grow-back cycle: capacity returns mid-run (4 -> 8) and the
+    run re-expands onto it — same oracle, same tolerance.  Growth is
+    the direction the checkpoint-relaunch path never exercised."""
+    w = zero_world
+    p_res, _ = _run_elastic(
+        w, w["mesh4"], w["step4"], w["mesh8"], w["step8"]
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5
+        ),
+        p_res, w["p_ref"],
+    )
+
+
+def test_rules_relower_with_mesh_and_lint_clean(
+    devices8, strategy_report
+):
+    """The rule-engine re-lower seam: with_mesh carries the SAME table
+    onto the survivor mesh (strategy-as-data — no new module, no
+    builder fork), elastic.relower routes a table through it, and the
+    zero3-rules strategy pins graft-lint/graft-shard CLEAN on the
+    4-way survivor mesh (the session cache's default mesh IS the
+    surviving size of the 8 -> 4 pin above)."""
+    mesh8 = make_mesh(devices8, data=8)
+    mesh4 = make_mesh(devices8[:4], data=4)
+    part8 = RulePartitioner(mesh8, TABLES["zero3"])
+    part4 = part8.with_mesh(mesh4)
+    assert part4.table is part8.table
+    assert part4.mesh is mesh4
+    assert part4.axis == part8.axis
+
+    # relower() builds a runnable step on the survivor without tracing
+    params = {"w1": jnp.ones((8, 4)), "b1": jnp.zeros((4,))}
+
+    def loss_fn(p, batch, key):
+        del key
+        x, y = batch
+        return jnp.mean((x @ p["w1"] + p["b1"] - y) ** 2)
+
+    step = elastic.relower(
+        part8, mesh4, loss_fn=loss_fn, tx=optax.sgd(0.1),
+        params_template=params, per_shard_rng=False, donate=False,
+    )
+    assert callable(step)
+
+    # graft-lint + graft-shard clean on the surviving mesh: compile
+    # analytics' registered zero3-rules entry (default mesh = 4) via
+    # the session's lower-once cache — zero extra compiles here
+    rep = strategy_report("zero3-rules")
+    assert rep["mesh"] == {"data": 4}
+    unwaived = [
+        f for f in rep.get("findings", []) if not f.get("waived")
+    ]
+    assert unwaived == [], unwaived
+    assert rep.get("signature_violations") == []
+    assert rep["meta"]["rule_table"]["name"] == "zero3-rules"
+
+
+def test_autosaver_note_reshape_refreshes_leaf_shapes(tmp_path):
+    """After a reshape the manifest's recorded leaf_shapes are the OLD
+    mesh's — stale for the next cross-mesh resume.  note_reshape drops
+    the cache (and the prior lineage's copy) so the next save records
+    the survivor layout."""
+    from ddl25spring_tpu.ft import AutoSaver, read_manifest, resume_bundle
+
+    saver = AutoSaver(tmp_path / "ck", save_every=1, async_save=False)
+    saver.save(0, resume_bundle({"w": jnp.ones((8, 4))}, {}))
+    man = read_manifest(tmp_path / "ck")
+    shapes = [tuple(s) for s, _ in man["leaf_shapes"]]
+    assert (8, 4) in shapes
+    saver.note_reshape(old={"data": 8}, new={"data": 4}, step=1)
+    saver.save(1, resume_bundle({"w": jnp.ones((4, 8))}, {}))
+    saver.close()
+    man = read_manifest(tmp_path / "ck")
+    shapes = [tuple(s) for s, _ in man["leaf_shapes"]]
+    assert (4, 8) in shapes and (8, 4) not in shapes
+    assert man["meta"]["reshape"]["new"] == {"data": 4}
+
+
+def test_surviving_devices_bounds(devices8):
+    assert len(elastic.surviving_devices(devices8, lose=4)) == 4
+    assert len(elastic.surviving_devices(devices8, size=2)) == 2
+    with pytest.raises(ValueError):
+        elastic.surviving_devices(devices8, lose=8)
+    with pytest.raises(ValueError):
+        elastic.surviving_devices(devices8, size=9)
+
+
+# ----------------------------------------------------- serve: handoff
+
+
+@pytest.fixture(scope="module")
+def serve_world():
+    from ddl25spring_tpu.models import llama
+    from ddl25spring_tpu.utils.config import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=64, dmodel=16, num_heads=2, n_layers=2, ctx_size=32,
+        dtype="float32",
+    )
+    params = llama.init_llama_params(jax.random.PRNGKey(0), cfg)
+    # the test_serve smoke geometry — every compiled program rides the
+    # session-wide _PROGRAM_CACHE shared with tests/test_serve.py
+    knobs = dict(
+        page_len=4, n_pages=16, max_slots=2, prefill_batch=2,
+        max_prompt_len=8, max_queue=32, token_budget=None, eos_id=None,
+        prefix_cache=False, spec_k=0, draft_layers=1,
+    )
+    return cfg, params, knobs
+
+
+def _dense_oracle(params, cfg, prompt, max_new):
+    from conftest import cached_lowering
+    from ddl25spring_tpu.models import decode as dm
+
+    def build():
+        toks = dm.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=max_new, temperature=0.0,
+        )
+        return [int(t) for t in np.asarray(toks)[0]]
+
+    return cached_lowering(
+        ("serve-dense", tuple(prompt), max_new), build
+    )
+
+
+def test_scale_down_handoff_zero_drops_token_exact(
+    serve_world, tmp_path
+):
+    """device_loss mid-traffic: the victim replica drains its live
+    slots through the ordinary release discipline, its queued requests
+    re-admit on the survivor, NOTHING accepted is lost, and every
+    completed stream is token-for-token the dense oracle's — the
+    handoff moved scheduling, never tokens."""
+    from ddl25spring_tpu.serve.driver import elastic_serve_run
+
+    cfg, params, knobs = serve_world
+    prompt_a, new_a = [5, 9, 11, 3], 9
+    prompt_b, new_b = [7, 2, 8], 6
+    trace = [
+        {"t": 0.0, "prompt": prompt_a, "max_new": new_a},
+        {"t": 0.0, "prompt": prompt_b, "max_new": new_b},
+        {"t": 0.001, "prompt": prompt_a, "max_new": new_a},
+        {"t": 0.001, "prompt": prompt_b, "max_new": new_b},
+        {"t": 0.002, "prompt": prompt_a, "max_new": new_a},
+        {"t": 0.002, "prompt": prompt_b, "max_new": new_b},
+    ]
+    chaos = ChaosInjector(
+        parse_chaos("device_loss@2"), state_dir=tmp_path
+    )
+    cell = elastic_serve_run(
+        params, cfg, trace, knobs, chaos=chaos, replicas=2,
+        keep_requests=True,
+    )
+    assert cell["dropped_requests"] == 0
+    assert cell["submitted"] == 6
+    assert cell["completed"] + cell["rejected"] == 6
+    assert cell["completed"] >= 4  # the tiny queue bound may reject
+    (ev,) = cell["events"]
+    assert ev["reason"] == "device_loss"
+    assert ev["old"] == 2 and ev["new"] == 1
+    assert ev["t_end"] >= ev["t"]  # the drain ran to completion
+    assert cell["replicas_end"] == 1
+    # token-exactness across the handoff: whichever replica served a
+    # request — including those re-admitted from the victim's queue —
+    # the stream is the dense oracle's
+    oracle = {
+        (tuple(prompt_a), new_a): _dense_oracle(
+            params, cfg, prompt_a, new_a
+        ),
+        (tuple(prompt_b), new_b): _dense_oracle(
+            params, cfg, prompt_b, new_b
+        ),
+    }
+    for req in cell["_requests"]:
+        assert req.tokens == oracle[
+            (tuple(req.prompt), req.max_new_tokens)
+        ], req.rid
+
+
+def test_handoff_forces_past_full_survivor_queue(serve_world, tmp_path):
+    """Regression: the victim's queued (already-accepted) requests must
+    re-admit even when every survivor queue sits AT max_queue — the
+    zero-drop contract outranks the door bound, so the handoff seats
+    them directly instead of bouncing queue_full into a silent loss
+    (which the dropped_requests counter could not see: they were never
+    'admitted')."""
+    from ddl25spring_tpu.serve.driver import elastic_serve_run
+
+    cfg, params, knobs = serve_world
+    knobs = dict(knobs, max_queue=2)
+    # 4 arrivals fill both replicas' slots at t=0; 4 more land on the
+    # next tick and fill both queues to the max_queue bound; the loss
+    # at iteration 3 then hands the victim's 2 queued requests to a
+    # survivor whose queue is already full
+    trace = [
+        {"t": 0.0, "prompt": [5, 9, 11, 3], "max_new": 6}
+        for _ in range(4)
+    ] + [
+        {"t": 0.005, "prompt": [5, 9, 11, 3], "max_new": 6}
+        for _ in range(4)
+    ]
+    chaos = ChaosInjector(
+        parse_chaos("device_loss@3"), state_dir=tmp_path
+    )
+    cell = elastic_serve_run(
+        params, cfg, trace, knobs, chaos=chaos, replicas=2,
+        tick_s=0.01,
+    )
+    (ev,) = cell["events"]
+    assert ev["requeued"] == 2, cell["events"]
+    assert cell["submitted"] == 8
+    assert cell["rejected"] == 0
+    assert cell["completed"] == 8  # every accepted request served
+    assert cell["dropped_requests"] == 0
+
+
+def test_traffic_spike_autoscales_and_windows_defined(
+    serve_world, tmp_path
+):
+    """A deterministic traffic_spike burst drives the queue-depth
+    autoscaler into a scale-up, the reshape cell splits TTFT into
+    window vs steady, and the --check-reshape gate passes the cell."""
+    from tools.serve_report import check_reshape
+
+    from ddl25spring_tpu.serve.driver import elastic_serve_run
+
+    cfg, params, knobs = serve_world
+    trace = [
+        {"t": 0.001 * i, "prompt": [5, 9, 11, 3], "max_new": 6}
+        for i in range(8)
+    ]
+    chaos = ChaosInjector(
+        parse_chaos("traffic_spike@1:12,device_loss@8"),
+        state_dir=tmp_path,
+    )
+    cell = elastic_serve_run(
+        params, cfg, trace, knobs, chaos=chaos, replicas=2,
+        max_replicas=3,
+    )
+    reasons = [e["reason"] for e in cell["events"]]
+    assert "traffic_spike_scale_up" in reasons, cell["events"]
+    assert "device_loss" in reasons
+    assert cell["dropped_requests"] == 0
+    assert cell["reshape_window_requests"] >= 1
+    assert cell["ttft_s_p95_reshape"] is not None
+    # the gate's verdict on this cell (ledger-row shaped): clean
+    assert check_reshape([{"reshape": cell}], ttft_factor=50.0) == []
+
+
+def test_check_reshape_gate_refuses_bad_cells():
+    """Every failure mode the gate exists for: no cell, no events,
+    dropped requests, a vacuous (empty) window, and an unbounded
+    TTFT blowup."""
+    from tools.serve_report import check_reshape
+
+    good = {
+        "events": [{"reason": "device_loss", "old": 2, "new": 1,
+                    "t": 0.1, "t_end": 0.2}],
+        "dropped_requests": 0,
+        "admitted": 10, "completed": 10,
+        "ttft_s_p95_steady": 0.1, "ttft_s_p95_reshape": 0.2,
+        "reshape_window_requests": 3, "steady_requests": 7,
+    }
+    assert check_reshape([{"reshape": good}]) == []
+    assert check_reshape([{}])  # no cell at all
+    assert any(
+        "no events" in f
+        for f in check_reshape([{"reshape": {**good, "events": []}}])
+    )
+    assert any(
+        "dropped_requests=2" in f
+        for f in check_reshape(
+            [{"reshape": {**good, "dropped_requests": 2,
+                          "completed": 8}}]
+        )
+    )
+    assert any(
+        "vacuous" in f
+        for f in check_reshape(
+            [{"reshape": {**good, "reshape_window_requests": 0}}]
+        )
+    )
+    assert any(
+        "exceeds" in f
+        for f in check_reshape(
+            [{"reshape": {**good, "ttft_s_p95_reshape": 0.5}}]
+        )
+    )
+    # and the factor knob moves the bound
+    assert check_reshape(
+        [{"reshape": {**good, "ttft_s_p95_reshape": 0.5}}],
+        ttft_factor=10.0,
+    ) == []
+
+
+def test_engine_begin_drain_blocks_admission_and_hands_off(serve_world):
+    """The engine-level handoff contract directly: a draining engine
+    admits nothing, returns its queued (never-admitted) requests, and
+    reports drained exactly when its live slots have released."""
+    from ddl25spring_tpu.serve.engine import ServeEngine
+
+    cfg, params, knobs = serve_world
+    eng = ServeEngine(params, cfg, clock="virtual", **knobs)
+    r1 = eng.make_request([5, 9, 11, 3], 3)
+    r2 = eng.make_request([7, 2, 8], 3)
+    r3 = eng.make_request([7, 2, 8, 1], 3)
+    for r in (r1, r2, r3):
+        assert eng.submit(r) is None
+    eng.step()  # admits r1+r2 (prefill width 2), r3 still queued
+    assert eng.admitted == 2
+    handoff = eng.begin_drain()
+    assert [r.rid for r in handoff] == [r3.rid]
+    assert not eng.drained  # r1/r2 still decoding
+    steps = 0
+    while not eng.drained:
+        eng.step()
+        steps += 1
+        assert steps < 50, "draining engine failed to finish live work"
+    assert eng.completed == 2
+    assert eng.admitted == 2  # r3 was never admitted here
+    # a draining replica bounces direct submits with its own reason —
+    # it must never accumulate work it will not admit
+    from ddl25spring_tpu.serve.engine import REJECT_DRAINING
+
+    assert eng.submit(eng.make_request([5], 2)) == REJECT_DRAINING
+    assert eng.drained  # still empty: the bounce never queued
+
+
+def test_flight_record_and_recovery_report_carry_reshape(tmp_path):
+    """The observability half: a reshape flight record lands in the
+    dump, summarize_run surfaces it under recovery, and the health
+    gate stays green (a reshape is recovery, not a violation)."""
+    from ddl25spring_tpu.obs.recorder import FlightRecorder
+    from ddl25spring_tpu.obs.report import summarize_run
+
+    fr = FlightRecorder()
+    fr.configure(run_dir=str(tmp_path))
+    fr.record(
+        kind="reshape", scope="train", reason="device_loss",
+        old={"data": 2}, new={"data": 1}, wall_s=0.5, steps_lost=0,
+    )
+    path = fr.dump(reason="end_of_run")
+    doc = json.loads(open(path).read())
+    assert doc["counts"]["reshape"] == 1
+    s = summarize_run(str(tmp_path))
+    assert s["recovery"]["reshapes"] == 1
+    assert s["recovery"]["last_reshape"]["reason"] == "device_loss"
+    assert s["health"].get("violations", 0) == 0

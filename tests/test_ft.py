@@ -58,7 +58,12 @@ def test_parse_chaos_grammar():
     assert parse_chaos("kill@7, nan_grad@5") == (
         Fault("kill", 7), Fault("nan_grad", 5),
     )
-    for bad in ("boom@3", "sigterm", "sigterm@", "sigterm@x", "sigterm@-1"):
+    # the PR-14 signal kinds (full matrix in tests/test_elastic.py)
+    assert parse_chaos("traffic_spike@8:16,capacity_change@5:4") == (
+        Fault("traffic_spike", 8, 16), Fault("capacity_change", 5, 4),
+    )
+    for bad in ("boom@3", "sigterm", "sigterm@", "sigterm@x", "sigterm@-1",
+                "sigterm@5:2", "capacity_change@5:0"):
         with pytest.raises(ValueError):
             parse_chaos(bad)
 

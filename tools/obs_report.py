@@ -52,6 +52,16 @@ def main(argv=None) -> int:
     if args.check_health:
         h = summary.get("health") or {}
         problems = []
+        # an elastic in-run reshape (ft/elastic.py, flight kind=
+        # "reshape") is RECOVERY, not damage: the gate names it so the
+        # log is explicit, and never fails on it
+        reshapes = (summary.get("recovery") or {}).get("reshapes")
+        if reshapes:
+            print(
+                f"note: {reshapes} elastic reshape(s) recorded for "
+                f"{args.run_dir} — recovery events, not violations",
+                file=sys.stderr,
+            )
         if h.get("violations"):
             problems.append(f"{h['violations']} sentinel violation(s)")
         if h.get("stall"):

@@ -41,7 +41,11 @@ pass the bitwise gate vacuously, so it fails instead).  On spec runs
 (``spec`` in the key) ``acceptance_rate`` joins the banded trend keys:
 deterministic on the seeded trace, it collapses when the drafter or
 the acceptance walk regresses, long before the noisy wall clocks
-notice.
+notice.  ``--check-reshape`` adds the elastic-reshape verdict (PR 14):
+the latest row's reshape cell must show >= 1 driven scale event, ZERO
+dropped (accepted-then-lost) requests across the replica handoff, and
+reshape-window p95 TTFT within ``--reshape-ttft-factor`` (default 3x)
+of steady state over a non-empty window.
 
 Pure stdlib — no jax import, so the gate runs anywhere the JSON does.
 """
@@ -296,6 +300,69 @@ def check_prefix_ab(recs: list[dict]) -> list[str]:
     return fails
 
 
+DEFAULT_RESHAPE_TTFT_FACTOR = 3.0
+
+
+def check_reshape(
+    recs: list[dict],
+    ttft_factor: float = DEFAULT_RESHAPE_TTFT_FACTOR,
+) -> list[str]:
+    """The elastic-reshape acceptance verdict on the latest row
+    (PR 14): the reshape cell must exist and show (a) at least one
+    reshape event actually driven, (b) ZERO dropped requests across the
+    handoff — a request accepted is a request served, the page-pool
+    handoff's whole contract — and (c) p95 TTFT inside the reshape
+    windows bounded at ``ttft_factor`` x the steady-state p95, over a
+    non-empty window (an event nobody was waiting through proves
+    nothing, the same vacuity hole the compared_requests guards
+    close)."""
+    if not recs:
+        return []
+    rsh = recs[-1].get("reshape")
+    if not isinstance(rsh, dict):
+        return ["latest record carries no reshape cell (arm elastic "
+                "chaos — DDL25_CHAOS=traffic_spike@k / device_loss@k / "
+                "capacity_change@k:N — on a bench.py --serve run to "
+                "record one)"]
+    fails: list[str] = []
+    events = rsh.get("events") or []
+    if not events:
+        fails.append(
+            "reshape cell carries no events: the armed chaos never "
+            "drove a scale-up/down (wrong step index for the trace?)"
+        )
+    dropped = rsh.get("dropped_requests")
+    if dropped != 0:
+        fails.append(
+            f"dropped_requests={dropped}: an admitted request was lost "
+            f"across the handoff (admitted {rsh.get('admitted')} vs "
+            f"completed {rsh.get('completed')}) — the drain/re-admit "
+            "discipline must never lose accepted work"
+        )
+    steady = rsh.get("ttft_s_p95_steady")
+    window = rsh.get("ttft_s_p95_reshape")
+    n_window = rsh.get("reshape_window_requests")
+    if not isinstance(n_window, int) or n_window < 1:
+        fails.append(
+            f"reshape_window_requests={n_window}: no request's first "
+            "token landed inside a reshape window, so the TTFT bound "
+            "is vacuous — fire the event while traffic is live"
+        )
+    elif not (isinstance(steady, (int, float))
+              and isinstance(window, (int, float))):
+        fails.append(
+            f"reshape TTFT percentiles undefined (steady={steady}, "
+            f"reshape={window}) with {n_window} window request(s)"
+        )
+    elif window > ttft_factor * steady:
+        fails.append(
+            f"p95 TTFT through the reshape window {window * 1e3:.2f} ms "
+            f"exceeds {ttft_factor:.1f}x the steady-state p95 "
+            f"{steady * 1e3:.2f} ms (over {n_window} window request(s))"
+        )
+    return fails
+
+
 def check_spec_ab(recs: list[dict]) -> list[str]:
     """The speculative-decoding acceptance verdict on the latest row
     (PR 13): the spec-on-vs-off cell must exist and show real accepted
@@ -507,6 +574,29 @@ def format_run(doc: dict) -> str:
             f"{_fmt(spec_arm.get('acceptance_rate'), 1, 100, '%')}"
             f"  tokens match {sab.get('tokens_match')}",
         ]
+    rsh = doc.get("reshape")
+    if rsh:
+        evs = rsh.get("events") or []
+        lines += [
+            "",
+            f"  elastic reshape ({len(evs)} event(s), replicas "
+            f"{rsh.get('replicas_start')} -> {rsh.get('replicas_end')}, "
+            f"dropped {rsh.get('dropped_requests')}):",
+        ]
+        for ev in evs:
+            lines.append(
+                f"    {ev.get('reason')}: {ev.get('old')} -> "
+                f"{ev.get('new')} at t={_fmt(ev.get('t'), 3)} s"
+                f" (drained by {_fmt(ev.get('t_end'), 3)} s,"
+                f" requeued {ev.get('requeued') or 0})"
+            )
+        lines.append(
+            f"    TTFT p95 reshape window "
+            f"{_fmt(rsh.get('ttft_s_p95_reshape'), 1, 1e3, ' ms')} "
+            f"({rsh.get('reshape_window_requests')} req) vs steady "
+            f"{_fmt(rsh.get('ttft_s_p95_steady'), 1, 1e3, ' ms')} "
+            f"({rsh.get('steady_requests')} req)"
+        )
     if doc.get("ttft_s"):
         lines += ["", "  TTFT histogram:"] + histogram(doc["ttft_s"])
     if doc.get("tick_wall_s"):
@@ -597,8 +687,20 @@ def main(argv=None) -> int:
                          "draft tokens, a strict virtual-clock win, and "
                          "matching token streams over >= 1 compared "
                          "request (implies --check)")
+    ap.add_argument("--check-reshape", action="store_true",
+                    help="also fail when the latest row's elastic "
+                         "reshape cell does not show >= 1 driven event, "
+                         "ZERO dropped (accepted-then-lost) requests "
+                         "across the replica handoff, and reshape-"
+                         "window p95 TTFT within --reshape-ttft-factor "
+                         "of steady state (implies --check)")
+    ap.add_argument("--reshape-ttft-factor", type=float,
+                    default=DEFAULT_RESHAPE_TTFT_FACTOR,
+                    help="allowed p95 TTFT inflation through a reshape "
+                         "window vs steady state (default 3.0)")
     args = ap.parse_args(argv)
-    if args.check_ab or args.check_prefix_ab or args.check_spec_ab:
+    if (args.check_ab or args.check_prefix_ab or args.check_spec_ab
+            or args.check_reshape):
         args.check = True  # a verdict nobody reads is not a gate
 
     if args.run_dir is None and not args.ledger_only:
@@ -641,13 +743,16 @@ def main(argv=None) -> int:
                 fails += check_prefix_ab(recs)
             if args.check_spec_ab:
                 fails += check_spec_ab(recs)
+            if args.check_reshape:
+                fails += check_reshape(recs, args.reshape_ttft_factor)
         if len(recs) < 2:
             if not fails:
                 note = "no baseline yet (single record)"
         else:
             fails += check_group(recs, args.tolerance, args.window)
         verdicts[key] = {"fails": fails, "note": note}
-    if ((args.check_ab or args.check_prefix_ab or args.check_spec_ab)
+    if ((args.check_ab or args.check_prefix_ab or args.check_spec_ab
+            or args.check_reshape)
             and ab_scope is not None and ab_scope not in groups):
         # the run under test never landed in this ledger (custom
         # --ledger path): judge its serve.json directly
@@ -656,6 +761,8 @@ def main(argv=None) -> int:
             fails += check_prefix_ab([doc])
         if args.check_spec_ab:
             fails += check_spec_ab([doc])
+        if args.check_reshape:
+            fails += check_reshape([doc], args.reshape_ttft_factor)
         verdicts[ab_scope] = {"fails": fails, "note": None}
     bad = sum(len(v["fails"]) for v in verdicts.values())
 
@@ -679,6 +786,8 @@ def main(argv=None) -> int:
             ab_note += ", prefix A/B advantage verified"
         if args.check_spec_ab:
             ab_note += ", spec A/B advantage verified"
+        if args.check_reshape:
+            ab_note += ", reshape handoff verified"
         print(f"\nserve check OK: {len(groups)} key(s) within the "
               f"{args.tolerance:.2f} tolerance band{ab_note}",
               file=sys.stderr)
