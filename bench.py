@@ -834,8 +834,20 @@ def main(argv=None) -> None:
     # (the training phases below never run; the serve driver owns the
     # ramp, the continuous-vs-static A/B, serve.json, and the ledger row)
     if args.serve:
+        from ddl25spring_tpu import obs
         from ddl25spring_tpu.obs import sentinels as _sentinels
+        from ddl25spring_tpu.obs.timeline import timeline
         from ddl25spring_tpu.serve.driver import run_serve_bench, serve_cell
+
+        if args.obs_dir:
+            # graft-trace (PR 16): enable BEFORE the engines build so
+            # the serve spans + request timeline record (the flag is
+            # read at emission time; everything here is host-side, so
+            # the compiled serve programs are byte-identical either
+            # way — pinned in tests/test_timeline.py)
+            obs.enable()
+            obs.set_recorder(obs.SpanRecorder(process_name="serve"))
+            timeline.configure(run_dir=args.obs_dir)
 
         record = run_serve_bench(
             smoke=args.smoke,
@@ -868,6 +880,15 @@ def main(argv=None) -> None:
         }
         if args.obs_dir:
             health["flight_dump"] = flight.dump(reason="end_of_run")
+            # the other two thirds of the merged trace: host spans
+            # (trace.json) + the request timeline — what
+            # tools/trace_export.py folds into one Perfetto view
+            telemetry["trace"] = obs.get_recorder().save(
+                os.path.join(args.obs_dir, "trace.json")
+            )
+            timeline.flush()
+            telemetry["timeline"] = timeline.path
+            telemetry["timeline_events"] = timeline.snapshot()["emitted"]
         telemetry["health"] = health
         ramp = record["ramp"]
         print(json.dumps({

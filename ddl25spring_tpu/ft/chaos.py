@@ -245,7 +245,10 @@ class ChaosInjector:
 
     def _mark_fired(self, fault: Fault) -> None:
         # journal BEFORE executing: a SIGKILL two lines later must not
-        # erase the memory that this fault already fired
+        # erase the memory that this fault already fired.  The flight
+        # record below also mirrors onto the run timeline (the
+        # obs.timeline flight tap), so a chaos fire lands in
+        # trace_merged.json next to the requests it disrupted
         self._fired.add(fault.key)
         if self._state_path:
             os.makedirs(os.path.dirname(self._state_path), exist_ok=True)

@@ -142,7 +142,13 @@ def record_reshape(
     dict (what ``telemetry.resume.reshape`` / the serve reshape cell
     carry).  ``old``/``new`` are meshes or plain device/replica counts;
     ``reason`` names the trigger (``device_loss`` / ``capacity_change``
-    / ``traffic_spike``)."""
+    / ``traffic_spike``).
+
+    The flight record is also mirrored onto the run timeline
+    (:mod:`ddl25spring_tpu.obs.timeline`, via the flight tap) as the
+    reshape window's OPEN; the serve driver pairs it with a direct
+    ``reshape_end`` emit when the window closes, which is what
+    ``tools/trace_export.py`` renders as the track-level window span."""
     from ddl25spring_tpu.obs.recorder import flight
 
     event = {

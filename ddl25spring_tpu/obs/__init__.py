@@ -37,7 +37,13 @@ runtime counterpart):
   ``flight.json`` on unhandled exception / SIGTERM / atexit);
 - :mod:`~ddl25spring_tpu.obs.watchdog` — stall watchdog (fires when no
   step completes within a deadline; dumps all host thread stacks plus
-  the flight record).
+  the flight record);
+- :mod:`~ddl25spring_tpu.obs.timeline` — graft-trace: the unified run
+  timeline (typed append-only ``timeline.jsonl`` every subsystem emits
+  into: serve request lifecycles with virtual + wall clocks, chaos
+  fires, reshape windows, autosave, watchdog, sentinel violations —
+  merged with spans + flight into one Perfetto trace by
+  ``tools/trace_export.py``).
 
 Everything is gated by one trace-time flag (:mod:`~ddl25spring_tpu.obs.
 state`): disabled (the default), instrumented step functions lower to HLO
@@ -69,6 +75,7 @@ from ddl25spring_tpu.obs.spans import (
     span,
 )
 from ddl25spring_tpu.obs.state import enable, enabled, scoped
+from ddl25spring_tpu.obs.timeline import Timeline, timeline
 
 # compile-time analytics (obs/xla_analytics.py, obs/compile_report.py) are
 # imported lazily by their consumers — they pull in the parallel stack and
@@ -81,6 +88,8 @@ __all__ = [
     "SentinelViolation",
     "SpanRecorder",
     "StallWatchdog",
+    "Timeline",
+    "timeline",
     "counters",
     "flight",
     "sentinels",

@@ -100,7 +100,14 @@ class FlightRecorder:
         self._shutdown_hooks: dict[str, Any] = {}
         self._run_dir: str | None = None
         self._t0 = time.perf_counter()
+        self._t0_unix = time.time()
         self._last_beat = time.perf_counter()
+        # taps: callables invoked with every record (outside the lock,
+        # exceptions suppressed) — how obs.timeline mirrors narrating
+        # kinds (chaos/reshape/save/stall/violation…) into the unified
+        # event log without editing six call sites.  Taps survive
+        # reset(): they are wiring, not run state.
+        self._taps: list = []
         self._dumped_seq = -1
         self._installed = False
         self._prev_excepthook = None
@@ -148,7 +155,28 @@ class FlightRecorder:
             self._last[kind] = rec
             if touch:
                 self._last_beat = now
+        # taps run OUTSIDE the lock: a tap appends into its own
+        # lock-guarded structure (the timeline), and lock nesting across
+        # modules is how shutdown-path deadlocks are born.  A tap must
+        # never take down the subsystem that is narrating.
+        for tap in list(self._taps):
+            try:
+                tap(rec)
+            except Exception:  # noqa: BLE001 - observability stays passive
+                pass
         return rec
+
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(record)`` to every :meth:`record` call.
+        Idempotent per callable; taps persist across :meth:`reset`."""
+        with self._lock:
+            if fn not in self._taps:
+                self._taps.append(fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            if fn in self._taps:
+                self._taps.remove(fn)
 
     def beat(self) -> None:
         """Liveness tick without a record — the watchdog's heartbeat."""
@@ -176,6 +204,10 @@ class FlightRecorder:
                 "meta": dict(self._meta),
                 "capacity": self._records.maxlen,
                 "recorded": self._seq,
+                # anchors record t_s offsets to unix time so
+                # tools/trace_export.py can merge the ring with the
+                # span recorder and the timeline on one axis
+                "time_origin_unix_s": self._t0_unix,
                 "violations": self._counts.get("violation", 0),
                 "stalls": self._counts.get("stall", 0),
                 "counts": dict(self._counts),
@@ -195,6 +227,7 @@ class FlightRecorder:
             self._shutdown_hooks.clear()
             self._dumped_seq = -1
             self._t0 = time.perf_counter()
+            self._t0_unix = time.time()
             self._last_beat = time.perf_counter()
 
     # ---- dumping --------------------------------------------------------

@@ -159,6 +159,69 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
             if dts:
                 out["tick_interval_s_p50"] = float(np.percentile(dts, 50))
 
+    # the unified run timeline, when the run configured one
+    # (ddl25spring_tpu/obs/timeline.py): event counts by kind, the
+    # slowest requests with their TTFT decomposition, and which
+    # requests rode through each elastic reshape window (membership by
+    # virtual clock — comparable across deterministic A/B runs)
+    from ddl25spring_tpu.obs.timeline import TIMELINE_BASENAME, read_timeline
+
+    tlpath = os.path.join(run_dir, TIMELINE_BASENAME)
+    if os.path.exists(tlpath):
+        try:
+            _, tl_events = read_timeline(run_dir)
+            tl_counts: dict[str, int] = {}
+            for e in tl_events:
+                k = e.get("kind", "?")
+                tl_counts[k] = tl_counts.get(k, 0) + 1
+            firsts = [
+                e for e in tl_events
+                if e.get("kind") == "serve_first_token"
+                and isinstance(e.get("ttft_s"), (int, float))
+            ]
+            slowest = [
+                {
+                    k: e.get(k)
+                    for k in ("rid", "engine", "replica", "ttft_s",
+                              "queue_wait_s", "prefill_s",
+                              "first_decode_s", "vt_s")
+                }
+                for e in sorted(
+                    firsts, key=lambda e: -e["ttft_s"])[:5]
+            ]
+            windows = []
+            for end in tl_events:
+                if end.get("kind") != "reshape_end":
+                    continue
+                t0, t1 = end.get("t"), end.get("t_end")
+                members = sorted({
+                    e["rid"] for e in tl_events
+                    if "rid" in e
+                    and e.get("engine") == end.get("engine")
+                    and isinstance(e.get("vt_s"), (int, float))
+                    and t0 is not None and t1 is not None
+                    and t0 <= e["vt_s"] <= t1
+                })
+                windows.append({
+                    "reason": end.get("reason"),
+                    "t": t0,
+                    "t_end": t1,
+                    "old": end.get("old"),
+                    "new": end.get("new"),
+                    "requests": members,
+                })
+            out["timeline"] = {
+                "events": len(tl_events),
+                "counts": tl_counts,
+                "slowest_requests": slowest,
+                "reshape_windows": windows,
+            }
+        except (ValueError, json.JSONDecodeError, OSError) as e:
+            # a torn line (killed mid-write) must not cost the rest
+            out["timeline"] = {
+                "error": f"unreadable {TIMELINE_BASENAME}: {e}"
+            }
+
     tpath = os.path.join(run_dir, "trace.json")
     if os.path.exists(tpath):
         with open(tpath) as f:
@@ -469,6 +532,18 @@ def format_report(summary: dict[str, Any]) -> str:
                 f"  per-token p50 {sms(ramp.get('tok_latency_s_p50'))} "
                 f"p95 {sms(ramp.get('tok_latency_s_p95'))}"
             )
+            dec = ramp.get("ttft_decomp")
+            if dec and dec.get("requests"):
+                lines.append(
+                    f"  TTFT decomposition ({dec.get('clock')} clock, "
+                    f"{dec['requests']} req): queue-wait p50 "
+                    f"{sms(dec.get('queue_wait_s_p50'))} p95 "
+                    f"{sms(dec.get('queue_wait_s_p95'))}  prefill p50 "
+                    f"{sms(dec.get('prefill_s_p50'))} p95 "
+                    f"{sms(dec.get('prefill_s_p95'))}  first-decode "
+                    f"p50 {sms(dec.get('first_decode_s_p50'))} p95 "
+                    f"{sms(dec.get('first_decode_s_p95'))}"
+                )
             occ = ramp.get("page_pool_peak_occupancy")
             lines.append(
                 f"  page pool peak {ramp.get('page_pool_peak_pages')}"
@@ -567,6 +642,50 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("host spans (trace.json — load in Perfetto):")
         for n, cnt in summary["span_counts"].items():
             lines.append(f"  {n:<40} x{cnt}")
+
+    tl = summary.get("timeline")
+    if tl:
+        lines.append("")
+        lines.append(
+            "timeline (timeline.jsonl — merge with "
+            "tools/trace_export.py):"
+        )
+        if tl.get("error"):
+            lines.append(f"  {tl['error']}")
+        else:
+            lines.append(
+                f"  {tl.get('events', 0)} event(s): "
+                + "  ".join(
+                    f"{k}x{v}" for k, v in sorted(
+                        (tl.get("counts") or {}).items())
+                )
+            )
+
+            def tms(v):
+                return f"{v * 1e3:.2f} ms" if isinstance(
+                    v, (int, float)) else "n/a"
+
+            if tl.get("slowest_requests"):
+                lines.append("  slowest requests (TTFT = queue-wait + "
+                             "prefill + first-decode):")
+                for r in tl["slowest_requests"]:
+                    lines.append(
+                        f"    rid={r.get('rid')} "
+                        f"[{r.get('engine')}:r{r.get('replica')}] "
+                        f"TTFT {tms(r.get('ttft_s'))} = "
+                        f"queue {tms(r.get('queue_wait_s'))} + "
+                        f"prefill {tms(r.get('prefill_s'))} + "
+                        f"first-decode {tms(r.get('first_decode_s'))}"
+                    )
+            for w in tl.get("reshape_windows") or []:
+                reqs = w.get("requests") or []
+                lines.append(
+                    f"  reshape window [{w.get('reason')} "
+                    f"{w.get('old')}->{w.get('new')}] vt "
+                    f"{w.get('t')}..{w.get('t_end')} s: "
+                    f"{len(reqs)} request(s) in flight "
+                    f"{reqs[:10]}{'...' if len(reqs) > 10 else ''}"
+                )
 
     h = summary.get("health")
     if h:

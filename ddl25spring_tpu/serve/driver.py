@@ -164,6 +164,8 @@ def ab_compare(
             params, cfg, knobs, admission=adm, clock="virtual",
             tick_s=tick_s, temperature=temperature, sentinel=sentinel,
             prefill_batch=knobs["max_slots"],
+            # replayed traffic: keep the A/B arms off the run timeline
+            trace_label=None,
         )
         m = e.run(trace, max_steps=max_steps)
         engines[adm] = e
@@ -222,6 +224,7 @@ def prefix_ab_compare(
             params, cfg, knobs, admission="continuous", clock="virtual",
             tick_s=tick_s, temperature=temperature, sentinel=sentinel,
             prefill_batch=knobs["max_slots"], prefix_cache=cache_on,
+            trace_label=None,
         )
         m = e.run(trace, max_steps=max_steps)
         engines[arm] = e
@@ -300,6 +303,7 @@ def spec_ab_compare(
             params, cfg, knobs, admission="continuous", clock="virtual",
             tick_s=tick_s, temperature=0.0, sentinel=sentinel,
             prefill_batch=knobs["max_slots"], spec_k=k,
+            trace_label=None,
         )
         m = e.run(trace, max_steps=max_steps)
         engines[arm] = e
@@ -388,18 +392,27 @@ def elastic_serve_run(
     the p95-bounded comparison ``serve_report --check-reshape`` gates.
     """
     from ddl25spring_tpu.ft import elastic
+    from ddl25spring_tpu.obs.timeline import timeline
     from ddl25spring_tpu.serve.engine import Request
 
     if tick_s is None:
         tick_s = ab_tick_s(trace, knobs["max_slots"])
     elastic_kinds = ("traffic_spike", "capacity_change", "device_loss")
 
+    # replica identities are assigned MONOTONICALLY and never reused:
+    # ``reps.index(e)`` shifts when a drained replica leaves the list,
+    # and the per-replica timeline tracks need an id that survives the
+    # roster change
+    next_replica = [0]
+
     def build():
         e = _build_engine(
             params, cfg, knobs, admission="continuous", clock="virtual",
             tick_s=tick_s, temperature=temperature, sentinel=sentinel,
-            prefill_batch=knobs["max_slots"],
+            prefill_batch=knobs["max_slots"], trace_label="elastic",
         )
+        e.replica_id = next_replica[0]
+        next_replica[0] += 1
         return e
 
     reps = [build() for _ in range(replicas)]
@@ -453,6 +466,10 @@ def elastic_serve_run(
             wall_s=_time.perf_counter() - t0, steps_lost=0, t=round(t, 6),
         )
         ev["t_end"] = round(t, 6)  # a fresh replica serves immediately
+        timeline.emit(
+            "reshape_end", reason=reason, t=ev["t"], t_end=ev["t_end"],
+            old=ev["old"], new=ev["new"], vt=t, engine="elastic",
+        )
         events.append(ev)
 
     def scale_down(n_drop: int, reason: str) -> None:
@@ -466,6 +483,13 @@ def elastic_serve_run(
             for req in v.begin_drain():
                 route(req, force=True)
                 requeued += 1
+                # the handoff leg of the request's span chain: accepted
+                # on the victim, re-seated on a survivor without a
+                # second trip through the door
+                timeline.emit(
+                    "serve_drain_handoff", rid=req.rid,
+                    from_replica=v.replica_id, vt=t, engine="elastic",
+                )
         ev = elastic.record_reshape(
             scope="serve", reason=reason, old=old,
             new=old - len(victims), wall_s=_time.perf_counter() - t0,
@@ -524,6 +548,11 @@ def elastic_serve_run(
             if v.drained:
                 ev["t_end"] = round(t, 6)
                 ev["drained_slots"] = v.max_slots
+                timeline.emit(
+                    "reshape_end", reason=ev["reason"], t=ev["t"],
+                    t_end=ev["t_end"], old=ev["old"], new=ev["new"],
+                    vt=t, engine="elastic",
+                )
                 reps.remove(v)
                 retired.append(v)
                 draining.remove((v, ev))
@@ -619,7 +648,7 @@ def run_serve_bench(
     import jax
 
     from ddl25spring_tpu.models import llama
-    from ddl25spring_tpu.obs import flight, sentinels
+    from ddl25spring_tpu.obs import flight, sentinels, spans
     from ddl25spring_tpu.obs.logger import git_sha
     from ddl25spring_tpu.obs.perfscope import host_fingerprint
     from ddl25spring_tpu.obs.report import SERVE_BASENAME
@@ -668,37 +697,42 @@ def run_serve_bench(
     # --- ramp phase: wall clock, the measured serving numbers ----------
     eng = _build_engine(
         params, cfg, knobs, clock="wall", temperature=temperature,
-        sentinel=sentinel,
+        sentinel=sentinel, trace_label="ramp",
     )
     # compile OFF the clock: TTFT measures serving, not XLA.  With the
     # prefix cache on this includes the sharing ops and EVERY
     # start-offset prefill variant (scan starts are page-quantized, so
     # the universe is bounded and warmup covers it all)
-    eng.warmup()
-    ramp = eng.run(trace, budget_s=budget_s, max_steps=50_000)
+    with spans.span("serve.warmup", cat="serve"):
+        eng.warmup()
+    with spans.span("serve.ramp", cat="serve", requests=len(trace)):
+        ramp = eng.run(trace, budget_s=budget_s, max_steps=50_000)
 
     # --- continuous-vs-static A/B: virtual clock, deterministic -------
     ab = None
     if not skip_ab:
-        ab = ab_compare(
-            params, cfg, trace, knobs,
-            temperature=temperature, sentinel=sentinel,
-        )
+        with spans.span("serve.ab", cat="serve"):
+            ab = ab_compare(
+                params, cfg, trace, knobs,
+                temperature=temperature, sentinel=sentinel,
+            )
 
     # --- cached-vs-cold prefix A/B: virtual clock, deterministic ------
     prefix_ab = None
     if not skip_prefix_ab and knobs.get("prefix_cache"):
-        prefix_ab = prefix_ab_compare(
-            params, cfg, trace, knobs,
-            temperature=temperature, sentinel=sentinel,
-        )
+        with spans.span("serve.prefix_ab", cat="serve"):
+            prefix_ab = prefix_ab_compare(
+                params, cfg, trace, knobs,
+                temperature=temperature, sentinel=sentinel,
+            )
 
     # --- spec-on-vs-off A/B: virtual clock, deterministic -------------
     spec_ab = None
     if not skip_spec_ab and knobs.get("spec_k"):
-        spec_ab = spec_ab_compare(
-            params, cfg, trace, knobs, sentinel=sentinel,
-        )
+        with spans.span("serve.spec_ab", cat="serve"):
+            spec_ab = spec_ab_compare(
+                params, cfg, trace, knobs, sentinel=sentinel,
+            )
 
     # --- elastic replica reshaping (PR 14): armed chaos only ----------
     # DDL25_CHAOS=traffic_spike@k / capacity_change@k:N / device_loss@k
@@ -715,10 +749,11 @@ def run_serve_bench(
         "capacity_change"
     ) + chaos.pending("device_loss")
     if elastic_armed and not knobs.get("spec_k"):
-        reshape = elastic_serve_run(
-            params, cfg, trace, knobs, chaos=chaos,
-            temperature=temperature, sentinel=sentinel,
-        )
+        with spans.span("serve.elastic", cat="serve"):
+            reshape = elastic_serve_run(
+                params, cfg, trace, knobs, chaos=chaos,
+                temperature=temperature, sentinel=sentinel,
+            )
     elif elastic_armed:
         import warnings
 
@@ -816,6 +851,11 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
         "tokens_per_sec_per_chip": ramp.get("tokens_per_sec_per_chip"),
         "ttft_s_p50": ramp.get("ttft_s_p50"),
         "ttft_s_p95": ramp.get("ttft_s_p95"),
+        # the per-request TTFT decomposition (PR 16): queue-wait /
+        # prefill / first-decode percentiles, so a trend regression
+        # names its component ("p95 regressed because queue-wait
+        # doubled") without re-running the bench
+        "ttft_decomp": ramp.get("ttft_decomp"),
         "tok_latency_s_p50": ramp.get("tok_latency_s_p50"),
         "tok_latency_s_p95": ramp.get("tok_latency_s_p95"),
         "admitted": ramp.get("admitted"),
@@ -943,6 +983,7 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
         "tokens_per_sec_per_chip": ramp.get("tokens_per_sec_per_chip"),
         "ttft_s_p50": ramp.get("ttft_s_p50"),
         "ttft_s_p95": ramp.get("ttft_s_p95"),
+        "ttft_decomp": ramp.get("ttft_decomp"),
         "tok_latency_s_p50": ramp.get("tok_latency_s_p50"),
         "tok_latency_s_p95": ramp.get("tok_latency_s_p95"),
         "admitted": ramp.get("admitted"),

@@ -34,6 +34,7 @@ forbidden) and HBM budgets pin in CI like every training strategy.
 
 from __future__ import annotations
 
+import random
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -45,7 +46,8 @@ import numpy as np
 from jax import lax
 
 from ddl25spring_tpu.models import decode as decode_mod, llama
-from ddl25spring_tpu.obs import sentinels
+from ddl25spring_tpu.obs import sentinels, spans as _spans, state as _obs_state
+from ddl25spring_tpu.obs.timeline import timeline as _timeline
 from ddl25spring_tpu.serve import kv_pages
 from ddl25spring_tpu.serve.prefix import Match, PrefixCache
 from ddl25spring_tpu.utils.config import LlamaConfig
@@ -471,6 +473,17 @@ def _spec_programs(
 # ----------------------------------------------------------- host engine
 
 
+def _pct(xs, q):
+    """Nearest-rank percentile over any sample iterable (None when
+    empty) — shared by :meth:`ServeEngine.metrics` and the TTFT
+    decomposition cell."""
+    xs = sorted(xs)
+    if not xs:
+        return None
+    k = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
+    return xs[k]
+
+
 @dataclass
 class Request:
     """One inference request (host side)."""
@@ -481,6 +494,12 @@ class Request:
     arrival_t: float = 0.0
     # filled by the engine
     admitted_t: float | None = None
+    # TTFT decomposition stamps (engine clock): when the admitting
+    # prefill dispatch began, and what that prefill pass cost — the
+    # residual to first_token_t is the "first decode" component
+    # (drafter prefill under spec, host overhead on the wall clock)
+    prefill_start_t: float | None = None
+    prefill_s: float | None = None
     first_token_t: float | None = None
     done_t: float | None = None
     tokens: list = field(default_factory=list)
@@ -488,6 +507,92 @@ class Request:
     @property
     def prompt_len(self) -> int:
         return len(self.prompt)
+
+
+# default sample cap for the engine's per-run host reservoirs: far
+# above any smoke/test population (behavior identical below the cap),
+# small enough that a week-long soak holds kilobytes, not gigabytes
+RESERVOIR_CAP = 4096
+
+
+class Reservoir:
+    """Bounded uniform sample of a per-run series + exact summary.
+
+    The engine's per-request host lists (``ttft_s``, ``queue_depths``,
+    ``tick_wall_s``) previously grew linearly with requests — a slow
+    OOM on soak runs.  This is classic Algorithm-R reservoir sampling
+    with a dedicated seeded ``random.Random`` (the engine's jax key
+    stream is never touched, so token streams stay bitwise identical),
+    plus exact ``count``/``max``/``min``/``total`` maintained over the
+    FULL series so occupancy peaks and counts never degrade to "of the
+    sample".  Below ``cap`` it is exactly an insertion-ordered list —
+    the regime every test and smoke run lives in."""
+
+    __slots__ = ("cap", "count", "max", "min", "total", "_xs", "_rng",
+                 "_seed")
+
+    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0):
+        self.cap = int(cap)
+        self._seed = int(seed)
+        self._xs: list = []
+        self._rng = random.Random(self._seed)
+        self.count = 0
+        self.max = None
+        self.min = None
+        self.total = 0.0
+
+    def append(self, x) -> None:
+        self.count += 1
+        if isinstance(x, (int, float)) and not isinstance(x, bool):
+            self.total += x
+            if self.max is None or x > self.max:
+                self.max = x
+            if self.min is None or x < self.min:
+                self.min = x
+        if len(self._xs) < self.cap:
+            self._xs.append(x)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.cap:
+                self._xs[j] = x
+
+    def clear(self) -> None:
+        self._xs.clear()
+        self._rng = random.Random(self._seed)
+        self.count = 0
+        self.max = None
+        self.min = None
+        self.total = 0.0
+
+    def summary(self) -> dict:
+        """The exact-count cell (telemetry): what the full series did,
+        regardless of how much of it is still sampled."""
+        return {
+            "count": self.count,
+            "sampled": len(self._xs),
+            "cap": self.cap,
+            "max": self.max,
+            "min": self.min,
+            "mean": (
+                round(self.total / self.count, 6) if self.count else None
+            ),
+        }
+
+    def __len__(self) -> int:
+        return len(self._xs)
+
+    def __bool__(self) -> bool:
+        return bool(self._xs)
+
+    def __iter__(self):
+        return iter(self._xs)
+
+    def __getitem__(self, i):
+        return self._xs[i]
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Reservoir(count={self.count}, sampled={len(self._xs)},"
+                f" cap={self.cap})")
 
 
 class ServeEngine:
@@ -533,6 +638,7 @@ class ServeEngine:
         draft_layers: int = 1,
         draft_params: Params | None = None,
         draft_cfg: LlamaConfig | None = None,
+        trace_label: str | None = "serve",
     ):
         if admission not in ("continuous", "static"):
             raise ValueError(
@@ -574,6 +680,15 @@ class ServeEngine:
         self.admission = admission
         self.clock = clock
         self.tick_s = tick_s
+        # graft-trace identity (PR 16): which timeline track this
+        # engine's request-lifecycle events land on.  ``None`` keeps an
+        # engine off the timeline entirely — the driver's deterministic
+        # A/B arms use it so replayed traffic doesn't shadow the live
+        # run's story.  ``replica_id`` is STABLE for the engine's whole
+        # life (the elastic driver assigns monotonically; list indices
+        # shift when a drained replica leaves).
+        self.trace_label = trace_label
+        self.replica_id = 0
         self._key = jax.random.PRNGKey(seed)
         # kept for the lazily-compiled start-offset prefill variants
         self._temperature = temperature
@@ -700,9 +815,15 @@ class ServeEngine:
         # the accept histogram serve.json renders; coverage of 0 /
         # mid / k is what the bitwise pins assert they exercised
         self.spec_accept_counts: dict[int, int] = {}
-        self.queue_depths: list[int] = []
-        self.ttft_s: list[float] = []
-        self.tick_wall_s: list[float] = []
+        # bounded host series (PR 16): a soak run's memory no longer
+        # grows with requests; counts/peaks stay exact via the summary
+        self.queue_depths = Reservoir()
+        self.ttft_s = Reservoir()
+        self.tick_wall_s = Reservoir()
+        # per-request (queue_wait, prefill, first_decode) triples on
+        # the engine clock — the TTFT decomposition telemetry.serve
+        # and serve_report render
+        self.ttft_decomp = Reservoir()
         self.done: list[Request] = []
         # cumulative generated-token timeline [(t, tokens)], one point
         # per scheduler iteration — lets the continuous-vs-static A/B
@@ -716,6 +837,18 @@ class ServeEngine:
         if self.clock == "virtual":
             return self._vtime
         return time.perf_counter() - self._t0
+
+    def _tl(self, kind: str, **fields) -> None:
+        """One graft-trace timeline event on this engine's track.
+        Host-side only — never consumes RNG, never advances a clock —
+        and a no-op unless obs is enabled AND the engine is labelled,
+        so disabled runs stay bitwise identical (pinned)."""
+        if self.trace_label is None or not _obs_state.enabled():
+            return
+        _timeline.emit(
+            kind, vt=self.now(), engine=self.trace_label,
+            replica=self.replica_id, **fields,
+        )
 
     def warmup(self) -> None:
         """Compile all three programs (prefill, decode tick, release)
@@ -731,6 +864,8 @@ class ServeEngine:
         first real request's TTFT clock."""
         saved_eos, saved_budget = self.eos_id, self.token_budget
         self.eos_id, self.token_budget = None, None
+        # the compile probe is not traffic: keep it off the timeline
+        saved_label, self.trace_label = self.trace_label, None
         try:
             req = self.make_request([1], 2)  # 2nd token needs a decode tick
             if self.submit(req) is not None:
@@ -747,6 +882,7 @@ class ServeEngine:
                     break
         finally:
             self.eos_id, self.token_budget = saved_eos, saved_budget
+            self.trace_label = saved_label
         self.pool = kv_pages.init_page_pool(
             self.cfg, n_pages=self.n_pages, page_len=self.page_len,
             max_slots=self.max_slots, pages_per_seq=self.pages_per_seq,
@@ -820,7 +956,10 @@ class ServeEngine:
         self.pool_ok_failures = 0
         self.peak_pages = 0
         self.prefill_tokens_saved = self.prefill_flops_saved = 0
-        self.queue_depths, self.ttft_s, self.tick_wall_s = [], [], []
+        self.queue_depths.clear()
+        self.ttft_s.clear()
+        self.tick_wall_s.clear()
+        self.ttft_decomp.clear()
         self.done, self.token_log = [], []
         self._t0 = time.perf_counter()
 
@@ -888,6 +1027,11 @@ class ServeEngine:
     def submit(self, req: Request) -> str | None:
         """Admission control at the door.  Returns None on acceptance
         (queued), else the rejection reason (also counted)."""
+        self._tl(
+            "serve_submit", rid=req.rid, prompt_len=req.prompt_len,
+            max_new=req.max_new_tokens,
+            arrival_t=round(req.arrival_t, 6),
+        )
         reason = None
         total = req.prompt_len + req.max_new_tokens
         if self.draining:
@@ -921,6 +1065,7 @@ class ServeEngine:
             reason = REJECT_TOKEN_BUDGET
         if reason is not None:
             self.rejected[reason] = self.rejected.get(reason, 0) + 1
+            self._tl("serve_reject", rid=req.rid, reason=reason)
             return reason
         self.queue.append(req)
         return None
@@ -1112,6 +1257,13 @@ class ServeEngine:
             lens[row] = req.prompt_len
             starts[row] = m.matched
             slot_ids[row] = slot
+        # TTFT decomposition stamp: the engine-clock moment this batch
+        # left the queue for the device — everything before is
+        # queue-wait, everything from here to the prefill cost is
+        # prefill, the residual to first_token is first-decode
+        t_pre = self.now()
+        for slot, req, m in batch:
+            self._tl("serve_admit", rid=req.rid, slot=slot)
         self._adopt_batch(batch)
         prefill = self._prefill if start == 0 else _prefill_variant(
             self.cfg, max_prompt_len=self.max_prompt_len, start=start,
@@ -1119,12 +1271,15 @@ class ServeEngine:
             donate=self._donate,
         )
         t0 = time.perf_counter()
-        self.pool, first, ok = prefill(
-            self.params, self.pool, jnp.asarray(prompts),
-            jnp.asarray(lens), jnp.asarray(starts), jnp.asarray(slot_ids),
-            self._split_key(),
-        )
-        first = jax.device_get(first)
+        with _spans.span("serve.prefill", cat="serve",
+                         batch=len(batch), start=start):
+            self.pool, first, ok = prefill(
+                self.params, self.pool, jnp.asarray(prompts),
+                jnp.asarray(lens), jnp.asarray(starts),
+                jnp.asarray(slot_ids),
+                self._split_key(),
+            )
+            first = jax.device_get(first)
         if not bool(ok):
             self.pool_ok_failures += 1
         if self.spec_k:
@@ -1135,11 +1290,14 @@ class ServeEngine:
             # `first` is the committed stream).  Greedy: the key is
             # never consumed, so the engine's key stream — and with it
             # the spec-off bitwise twin — is untouched.
-            self.draft_pool, _draft_first, ok_d = self._draft_prefill(
-                self.draft_params, self.draft_pool, jnp.asarray(prompts),
-                jnp.asarray(lens), jnp.zeros((B,), jnp.int32),
-                jnp.asarray(slot_ids), self._zero_key,
-            )
+            with _spans.span("serve.draft_prefill", cat="serve",
+                             batch=len(batch)):
+                self.draft_pool, _draft_first, ok_d = self._draft_prefill(
+                    self.draft_params, self.draft_pool,
+                    jnp.asarray(prompts),
+                    jnp.asarray(lens), jnp.zeros((B,), jnp.int32),
+                    jnp.asarray(slot_ids), self._zero_key,
+                )
             if not bool(ok_d):
                 self.pool_ok_failures += 1
         wall = time.perf_counter() - t0
@@ -1156,8 +1314,20 @@ class ServeEngine:
             # the drafter's full-prompt scan, at its FLOP ratio
             self._advance(self.tick_s * self.spec_flop_ratio)
         now = self.now()
+        # what THIS prefill pass cost on the engine clock — the middle
+        # term of the TTFT decomposition.  Virtual: the target scan's
+        # deterministic charge (the drafter's charge lands in the
+        # first-decode residual).  Wall: the measured device wall of
+        # the pass (host overhead lands in the residual).
+        prefill_cost = (
+            self.tick_s * (self.max_prompt_len - start)
+            / self.max_prompt_len
+            if self.clock == "virtual" else wall
+        )
         for row, (slot, req, m) in enumerate(batch):
             req.admitted_t = now
+            req.prefill_start_t = t_pre
+            req.prefill_s = prefill_cost
             self.slots[slot] = req
             self._adopted_pages[slot] = list(m.pages)
             self._cached_pages[slot] = []
@@ -1181,9 +1351,30 @@ class ServeEngine:
             # request that completes at this very token is released by
             # the flush, which clears the pending list with the slot
             self._pending[slot] = [int(first[row])]
-            self._emit_token(slot, req, int(first[row]), now)
             req.first_token_t = now
-            self.ttft_s.append(now - req.arrival_t)
+            ttft = now - req.arrival_t
+            self.ttft_s.append(ttft)
+            # TTFT == queue_wait + prefill + first_decode by
+            # construction: the residual definition makes the virtual
+            # sum exact (pinned) and the wall sum exact up to float
+            # re-association
+            queue_wait = t_pre - req.arrival_t
+            first_decode = now - t_pre - prefill_cost
+            self.ttft_decomp.append((queue_wait, prefill_cost,
+                                     first_decode))
+            self._tl(
+                "serve_prefill", rid=req.rid, slot=slot, start=start,
+                prefix_hit_tokens=int(m.matched),
+                wall_s=round(wall, 6),
+            )
+            self._tl(
+                "serve_first_token", rid=req.rid,
+                ttft_s=round(ttft, 6),
+                queue_wait_s=round(queue_wait, 6),
+                prefill_s=round(prefill_cost, 6),
+                first_decode_s=round(first_decode, 6),
+            )
+            self._emit_token(slot, req, int(first[row]), now)
         if self.prefix is not None:
             self._insert_prefixes(batch)
         self._track_pages()
@@ -1203,6 +1394,7 @@ class ServeEngine:
             req.done_t = now
             self.completed += 1
             self.done.append(req)
+            self._tl("serve_done", rid=req.rid, tokens=len(req.tokens))
             self.slots[slot] = None
             self._reserved[slot] = 0
             self._release_mask[slot] = True
@@ -1223,10 +1415,14 @@ class ServeEngine:
             np.asarray(self._slot_last_tok, np.int32)
         )
         t0 = time.perf_counter()
-        self.pool, new_tok, ok = self._tick(
-            self.params, self.pool, toks, self._split_key()
-        )
-        new_tok = jax.device_get(new_tok)
+        with _spans.span(
+            "serve.decode_tick", cat="serve",
+            active=sum(r is not None for r in self.slots),
+        ):
+            self.pool, new_tok, ok = self._tick(
+                self.params, self.pool, toks, self._split_key()
+            )
+            new_tok = jax.device_get(new_tok)
         wall = time.perf_counter() - t0
         if not bool(ok):
             self.pool_ok_failures += 1
@@ -1286,10 +1482,11 @@ class ServeEngine:
 
         jlim = jnp.asarray(limits)
         t0 = time.perf_counter()
-        self.draft_pool, drafts_dev, ok_d = draft_fn(
-            self.draft_params, self.draft_pool,
-            jnp.asarray(ctx), jnp.asarray(n_ctx), jlim,
-        )
+        with _spans.span("serve.draft", cat="serve", steps=steps):
+            self.draft_pool, drafts_dev, ok_d = draft_fn(
+                self.draft_params, self.draft_pool,
+                jnp.asarray(ctx), jnp.asarray(n_ctx), jlim,
+            )
         # assemble the verify window ON DEVICE: draft and verify queue
         # back to back with no host sync in between (one device_get of
         # the small draft/greedy arrays after both dispatched)
@@ -1298,11 +1495,12 @@ class ServeEngine:
                          )[:, None], drafts_dev],
             axis=1,
         )
-        self.pool, greedy_dev, ok_v = self._verify(
-            self.params, self.pool, toks, jlim,
-        )
-        drafts = np.asarray(jax.device_get(drafts_dev))  # [S, k]
-        greedy = np.asarray(jax.device_get(greedy_dev))  # [S, k+1]
+        with _spans.span("serve.verify", cat="serve"):
+            self.pool, greedy_dev, ok_v = self._verify(
+                self.params, self.pool, toks, jlim,
+            )
+            drafts = np.asarray(jax.device_get(drafts_dev))  # [S, k]
+            greedy = np.asarray(jax.device_get(greedy_dev))  # [S, k+1]
         wall = time.perf_counter() - t0
         if not bool(ok_d):
             self.pool_ok_failures += 1
@@ -1350,6 +1548,11 @@ class ServeEngine:
                     break  # max_new / EOS — inside the draft window
             # the first min(a, emitted) emissions are draft-origin
             self.draft_tokens_accepted += min(a, emitted)
+            self._tl(
+                "serve_spec_round", rid=req.rid,
+                round=self._spec_rounds, accepted=a, rejected=k - a,
+                emitted=emitted,
+            )
             new_lens[slot] = p0 + emitted
             if self.slots[slot] is not None:
                 if emitted == k + 1:
@@ -1449,6 +1652,7 @@ class ServeEngine:
         self.draining = True
         handoff = list(self.queue)
         self.queue.clear()
+        self._tl("serve_drain", requeued=len(handoff))
         return handoff
 
     @property
@@ -1542,18 +1746,38 @@ class ServeEngine:
 
     # ---- telemetry -----------------------------------------------------
 
+    def ttft_decomp_cell(self) -> dict[str, Any]:
+        """Per-request TTFT decomposition, aggregated: TTFT ==
+        queue_wait (arrival -> prefill dispatch) + prefill (the
+        admitting pass's engine-clock cost) + first_decode (the
+        residual to the first token: drafter prefill under spec, host
+        overhead on the wall clock).  On the virtual clock the sum is
+        exact (pinned), which is what turns "p95 regressed" into "p95
+        regressed because queue-wait doubled" on deterministic A/Bs."""
+        qs = [d[0] for d in self.ttft_decomp]
+        ps = [d[1] for d in self.ttft_decomp]
+        fs = [d[2] for d in self.ttft_decomp]
+
+        def r(v):
+            return None if v is None else round(v, 6)
+
+        return {
+            "clock": self.clock,
+            "requests": self.ttft_decomp.count,
+            "queue_wait_s_p50": r(_pct(qs, 50)),
+            "queue_wait_s_p95": r(_pct(qs, 95)),
+            "prefill_s_p50": r(_pct(ps, 50)),
+            "prefill_s_p95": r(_pct(ps, 95)),
+            "first_decode_s_p50": r(_pct(fs, 50)),
+            "first_decode_s_p95": r(_pct(fs, 95)),
+        }
+
     def metrics(self, budget_s: float | None = None) -> dict[str, Any]:
         """The ``telemetry.serve`` cell: throughput, tail latency,
         admission counters, and pool occupancy — every key the BENCH
         contract (and ``tools/serve_report.py``) reads."""
 
-        def pct(xs, q):
-            if not xs:
-                return None
-            xs = sorted(xs)
-            k = min(len(xs) - 1, max(0, round(q / 100 * (len(xs) - 1))))
-            return xs[k]
-
+        pct = _pct
         wall = self.now()
         try:  # the chips the pool actually lives on (1 off-mesh)
             n_chips = max(1, len(self.pool["seq_len"].devices()))
@@ -1583,10 +1807,23 @@ class ServeEngine:
             "n_chips": n_chips,
             "ttft_s_p50": pct(self.ttft_s, 50),
             "ttft_s_p95": pct(self.ttft_s, 95),
+            "ttft_decomp": self.ttft_decomp_cell(),
             "tok_latency_s_p50": pct(tok_lat, 50),
             "tok_latency_s_p95": pct(tok_lat, 95),
-            "queue_depth_max": max(self.queue_depths, default=0),
+            # exact over the FULL series (the reservoir keeps the peak
+            # even after its samples rotate); p50 is of the sample
+            "queue_depth_max": (
+                self.queue_depths.max
+                if self.queue_depths.count else 0
+            ),
             "queue_depth_p50": pct(self.queue_depths, 50),
+            # exact-count summaries of the bounded host series — what
+            # a soak run's telemetry keeps when the samples rotate
+            "host_samples": {
+                "ttft_s": self.ttft_s.summary(),
+                "queue_depths": self.queue_depths.summary(),
+                "tick_wall_s": self.tick_wall_s.summary(),
+            },
             "page_pool_pages": self.n_pages,
             "page_pool_peak_pages": self.peak_pages,
             "page_pool_peak_occupancy": round(

@@ -13,6 +13,12 @@ whole optimization is gated the way this repo gates everything: a
 bitwise tokens-match pin plus a deterministic virtual-clock A/B
 (``serve_report --check-spec-ab``).
 
+Per-round observability: the engine emits one ``serve_spec_round``
+timeline event per slot per round (accepted/rejected counts, the
+request's rid — :mod:`ddl25spring_tpu.obs.timeline`), so acceptance
+behavior is inspectable per request in ``trace_merged.json``, not just
+as the run-level ``acceptance_rate``.
+
 The pieces:
 
 - **drafter** — a tiny LLaMA (same architecture, ``draft_layers`` /

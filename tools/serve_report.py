@@ -495,6 +495,22 @@ def format_run(doc: dict) -> str:
         f"({_fmt(ramp.get('page_pool_peak_occupancy'), 1, 100, '%')})"
         f"  pool-ok failures {ramp.get('pool_ok_failures')}",
     ]
+    # PR 16: the per-request TTFT decomposition — "p95 regressed"
+    # becomes "p95 regressed because queue-wait doubled"
+    dec = ramp.get("ttft_decomp") or {}
+    if dec.get("requests"):
+        lines.append(
+            f"  TTFT decomposition ({dec.get('clock')} clock, "
+            f"{dec['requests']} req): queue-wait p50 "
+            f"{_fmt(dec.get('queue_wait_s_p50'), 2, 1e3, ' ms')}"
+            f" p95 {_fmt(dec.get('queue_wait_s_p95'), 2, 1e3, ' ms')}"
+            f"  |  prefill p50 "
+            f"{_fmt(dec.get('prefill_s_p50'), 2, 1e3, ' ms')}"
+            f" p95 {_fmt(dec.get('prefill_s_p95'), 2, 1e3, ' ms')}"
+            f"  |  first-decode p50 "
+            f"{_fmt(dec.get('first_decode_s_p50'), 2, 1e3, ' ms')}"
+            f" p95 {_fmt(dec.get('first_decode_s_p95'), 2, 1e3, ' ms')}"
+        )
     prefix = ramp.get("prefix") or {}
     if prefix.get("enabled"):
         lines.append(

@@ -1,0 +1,411 @@
+"""Merge a run's telemetry into ONE Perfetto/Chrome trace (PR 16).
+
+    python tools/trace_export.py <run_dir>            # write trace_merged.json
+    python tools/trace_export.py <run_dir> --check    # + span-chain gate
+
+Inputs (all from the run directory; only the timeline is required):
+
+- ``timeline.jsonl``  — the graft-trace event log (``obs/timeline.py``):
+  serve request lifecycles, reshape windows, mirrored chaos / autosave /
+  watchdog / sentinel fires.  Its header's ``time_origin_unix_s``
+  anchors the merged trace's time axis.
+- ``trace.json``      — the ``obs/spans.py`` host spans (already Chrome
+  format); shifted onto the common axis via its own
+  ``otherData.time_origin_unix_s``.
+- ``flight.json``     — the flight-recorder ring; records become
+  instants on a "flight ring" track (needs the recorder's
+  ``time_origin_unix_s``, present from PR 16 on — older dumps are
+  skipped with a note).
+
+Output: ``trace_merged.json`` (Chrome JSON object format — open in
+https://ui.perfetto.dev or ``chrome://tracing``) with
+
+- one process ("track") per serve engine replica, one thread row per
+  request, each request a flow-arrow-linked span chain
+  ``queue -> prefill -> decode`` with ``first_token`` / ``spec_round`` /
+  ``reject`` / ``drain-handoff`` instants riding the rows;
+- a "subsystems" process: chaos / reshape / autosave / watchdog /
+  sentinel tracks, with each elastic reshape window rendered as a
+  track-level span (paired ``reshape`` -> ``reshape_end`` events);
+- the host spans and the flight ring alongside, on the same clock.
+
+``--check`` is the CI gate: every admitted request's span chain must be
+complete — no orphan ``serve_admit`` without a terminal ``serve_done``
+(a drain-handoff is an intermediate leg: the request must still admit
+and finish on a survivor).  Submitted-but-never-seated requests (run
+ended mid-queue under a wall budget) are reported, not failed.
+
+Everything here is stdlib-only, like the other report tools: the gate
+must run anywhere CI can run python.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TIMELINE_BASENAME = "timeline.jsonl"  # restated from obs/timeline.py
+TRACE_BASENAME = "trace.json"         # restated from obs/spans.py usage
+FLIGHT_BASENAME = "flight.json"       # restated from obs/recorder.py
+MERGED_BASENAME = "trace_merged.json"
+
+# synthetic pids, far above any real os.getpid() the span recorder
+# stamped, so the merged view never interleaves two unrelated tracks
+PID_SUBSYS = 1_000_000
+PID_FLIGHT = 1_000_001
+PID_REPLICA0 = 1_000_100  # + stable replica ordinal per serve track
+
+_SUBSYS_TIDS = {
+    "chaos": (1, "chaos"),
+    "reshape": (2, "reshape"),
+    "reshape_end": (2, "reshape"),
+    "save": (3, "autosave"),
+    "save_skipped": (3, "autosave"),
+    "restore": (3, "autosave"),
+    "stall": (4, "watchdog"),
+    "violation": (5, "sentinels"),
+}
+
+_REQUEST_KINDS = {
+    "serve_submit", "serve_reject", "serve_admit", "serve_prefill",
+    "serve_first_token", "serve_spec_round", "serve_done",
+    "serve_drain", "serve_drain_handoff",
+}
+
+
+def read_timeline(run_dir: str) -> tuple[dict, list[dict]]:
+    """(header, events) from timeline.jsonl — strict JSON, like the
+    writer (a NaN that sneaks in is a bug, not data)."""
+
+    def _reject(_):
+        raise ValueError("non-finite constant in timeline.jsonl")
+
+    header: dict = {}
+    events: list[dict] = []
+    with open(os.path.join(run_dir, TIMELINE_BASENAME)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line, parse_constant=_reject)
+            if rec.get("record") == "timeline_header":
+                header = rec
+            else:
+                events.append(rec)
+    if "time_origin_unix_s" not in header:
+        raise ValueError(
+            f"{TIMELINE_BASENAME} carries no header — configure() the "
+            "timeline at a run dir before emitting"
+        )
+    return header, events
+
+
+def _args_of(ev: dict) -> dict:
+    return {
+        k: v for k, v in ev.items()
+        if k not in ("record", "seq", "kind", "t_wall_s")
+    }
+
+
+def _group_requests(events: list[dict]) -> dict[tuple, dict]:
+    """Fold request-lifecycle events into per-(engine, rid) chains.
+    rids are only unique within an engine label (the ramp engine and
+    the elastic driver each count from their own 0)."""
+    chains: dict[tuple, dict] = {}
+    for ev in events:
+        if ev.get("kind") not in _REQUEST_KINDS or "rid" not in ev:
+            continue
+        key = (ev.get("engine", "serve"), ev["rid"])
+        c = chains.setdefault(key, {"events": [], "replica": None})
+        c["events"].append(ev)
+        # the chain renders on the replica that SEATED the request
+        # (falls back to the submitting replica for rejected/queued)
+        if ev["kind"] == "serve_admit":
+            c["replica"] = ev.get("replica", 0)
+        elif c["replica"] is None and "replica" in ev:
+            c["replica"] = ev["replica"]
+    return chains
+
+
+def _first(chain: list[dict], kind: str) -> dict | None:
+    for ev in chain:
+        if ev["kind"] == kind:
+            return ev
+    return None
+
+
+def _last(chain: list[dict], kind: str) -> dict | None:
+    out = None
+    for ev in chain:
+        if ev["kind"] == kind:
+            out = ev
+    return out
+
+
+def check_chains(events: list[dict]) -> tuple[list[str], dict]:
+    """The --check gate.  Returns (failures, stats)."""
+    chains = _group_requests(events)
+    fails: list[str] = []
+    admitted = done = rejected = pending = handoffs = 0
+    for (engine, rid), c in sorted(chains.items(), key=lambda kv: (
+            kv[0][0], kv[0][1])):
+        evs = c["events"]
+        kinds = [e["kind"] for e in evs]
+        handoffs += kinds.count("serve_drain_handoff")
+        if "serve_admit" in kinds:
+            admitted += 1
+            if "serve_first_token" not in kinds:
+                fails.append(
+                    f"{engine}:rid={rid} admitted without a first_token"
+                )
+            if "serve_done" in kinds:
+                done += 1
+            else:
+                fails.append(
+                    f"{engine}:rid={rid} orphan admit — no terminal "
+                    f"serve_done (kinds: {kinds})"
+                )
+        elif "serve_reject" in kinds:
+            rejected += 1
+        else:
+            pending += 1  # never seated: ended the run still queued
+    stats = {
+        "requests": len(chains),
+        "admitted": admitted,
+        "complete": done,
+        "rejected": rejected,
+        "pending": pending,
+        "drain_handoffs": handoffs,
+    }
+    return fails, stats
+
+
+def merge(run_dir: str) -> tuple[dict, dict]:
+    """Build the merged Chrome trace; returns (trace_doc, notes)."""
+    header, events = read_timeline(run_dir)
+    t0_unix = header["time_origin_unix_s"]
+    out: list[dict] = []
+    notes: dict = {"timeline_events": len(events)}
+
+    def ts(ev: dict) -> float:  # event -> merged-axis microseconds
+        return ev["t_wall_s"] * 1e6
+
+    def meta(pid, name, tid=None, tname=None):
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": name}})
+        if tid is not None:
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+
+    # ---- serve request chains: track per replica, row per request --
+    chains = _group_requests(events)
+    replica_pids: dict[tuple, int] = {}
+    for (engine, rid), c in sorted(chains.items(), key=lambda kv: (
+            kv[0][0], kv[0][1])):
+        rep = c["replica"] or 0
+        rkey = (engine, rep)
+        if rkey not in replica_pids:
+            pid = PID_REPLICA0 + len(replica_pids)
+            replica_pids[rkey] = pid
+            meta(pid, f"serve:{engine} replica {rep}")
+        pid = replica_pids[rkey]
+        tid = rid + 1  # tid 0 is the metadata row
+        meta(pid, f"serve:{engine} replica {rep}", tid, f"req {rid}")
+        evs = c["events"]
+        flow_id = f"{engine}:{rid}"
+        sub = _first(evs, "serve_submit")
+        adm = _first(evs, "serve_admit")
+        rej = _first(evs, "serve_reject")
+        ftk = _first(evs, "serve_first_token")
+        dne = _last(evs, "serve_done")
+        base = {"pid": pid, "tid": tid, "cat": "serve_request"}
+        if sub is not None and adm is not None:
+            out.append({**base, "ph": "X", "name": "queue",
+                        "ts": ts(sub), "dur": max(ts(adm) - ts(sub), 1),
+                        "args": _args_of(sub)})
+            out.append({**base, "ph": "s", "id": flow_id, "name": "req",
+                        "ts": ts(sub)})
+        if sub is not None and rej is not None:
+            out.append({**base, "ph": "i", "s": "t", "name":
+                        f"reject:{rej.get('reason')}", "ts": ts(rej),
+                        "args": _args_of(rej)})
+        for ho in (e for e in evs if e["kind"] == "serve_drain_handoff"):
+            out.append({**base, "ph": "i", "s": "t",
+                        "name": "drain-handoff", "ts": ts(ho),
+                        "args": _args_of(ho)})
+        if adm is not None and ftk is not None:
+            out.append({**base, "ph": "X", "name": "prefill",
+                        "ts": ts(adm), "dur": max(ts(ftk) - ts(adm), 1),
+                        "args": _args_of(
+                            _first(evs, "serve_prefill") or adm)})
+            out.append({**base, "ph": "t", "id": flow_id, "name": "req",
+                        "ts": ts(adm) + 1})
+        if ftk is not None:
+            out.append({**base, "ph": "i", "s": "t", "name":
+                        "first_token", "ts": ts(ftk),
+                        "args": _args_of(ftk)})
+        if ftk is not None and dne is not None:
+            out.append({**base, "ph": "X", "name": "decode",
+                        "ts": ts(ftk), "dur": max(ts(dne) - ts(ftk), 1),
+                        "args": _args_of(dne)})
+            out.append({**base, "ph": "f", "bp": "e", "id": flow_id,
+                        "name": "req", "ts": ts(ftk) + 1})
+        for sr in (e for e in evs if e["kind"] == "serve_spec_round"):
+            out.append({**base, "ph": "i", "s": "t",
+                        "name": f"spec_round[{sr.get('accepted')}/"
+                                f"{sr.get('accepted', 0) + sr.get('rejected', 0)}]",
+                        "ts": ts(sr), "args": _args_of(sr)})
+
+    # ---- subsystem tracks (+ reshape windows as track spans) -------
+    meta(PID_SUBSYS, "subsystems")
+    seen_tids = set()
+    reshape_starts: list[dict] = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in _SUBSYS_TIDS:
+            continue
+        tid, tname = _SUBSYS_TIDS[kind]
+        if tid not in seen_tids:
+            seen_tids.add(tid)
+            meta(PID_SUBSYS, "subsystems", tid, tname)
+        if kind == "reshape":
+            reshape_starts.append(ev)
+        if kind == "reshape_end":
+            # pair with the matching start (same virtual t + reason)
+            start = next(
+                (s for s in reshape_starts
+                 if s.get("t") == ev.get("t")
+                 and s.get("reason") == ev.get("reason")), None)
+            ts0 = ts(start) if start is not None else ts(ev)
+            out.append({"pid": PID_SUBSYS, "tid": tid, "ph": "X",
+                        "cat": "reshape_window",
+                        "name": f"reshape:{ev.get('reason')}",
+                        "ts": ts0, "dur": max(ts(ev) - ts0, 1),
+                        "args": _args_of(ev)})
+            continue
+        out.append({"pid": PID_SUBSYS, "tid": tid, "ph": "i", "s": "t",
+                    "cat": "subsystem", "name": kind, "ts": ts(ev),
+                    "args": _args_of(ev)})
+    # serve_drain markers ride the reshape track too (replica roster)
+    for ev in events:
+        if ev.get("kind") != "serve_drain":
+            continue
+        tid, tname = _SUBSYS_TIDS["reshape"]
+        if tid not in seen_tids:
+            seen_tids.add(tid)
+            meta(PID_SUBSYS, "subsystems", tid, tname)
+        out.append({"pid": PID_SUBSYS, "tid": tid, "ph": "i", "s": "t",
+                    "cat": "subsystem",
+                    "name": f"drain:replica{ev.get('replica')}",
+                    "ts": ts(ev), "args": _args_of(ev)})
+
+    # ---- host spans (obs/spans.py trace.json) ----------------------
+    span_path = os.path.join(run_dir, TRACE_BASENAME)
+    notes["host_spans"] = 0
+    if os.path.exists(span_path):
+        with open(span_path) as f:
+            doc = json.load(f)
+        span_origin = (doc.get("otherData") or {}).get(
+            "time_origin_unix_s")
+        if span_origin is None:
+            notes["host_spans_note"] = (
+                f"{TRACE_BASENAME} has no time_origin_unix_s; skipped")
+        else:
+            shift = (span_origin - t0_unix) * 1e6
+            for ev in doc.get("traceEvents", []):
+                ev = dict(ev)
+                if "ts" in ev:
+                    ev["ts"] = ev["ts"] + shift
+                out.append(ev)
+                notes["host_spans"] += 1
+
+    # ---- the flight ring -------------------------------------------
+    flight_path = os.path.join(run_dir, FLIGHT_BASENAME)
+    notes["flight_records"] = 0
+    if os.path.exists(flight_path):
+        with open(flight_path) as f:
+            fdoc = json.load(f)
+        f_origin = fdoc.get("time_origin_unix_s")
+        if f_origin is None:
+            notes["flight_note"] = (
+                f"{FLIGHT_BASENAME} predates time_origin_unix_s; "
+                "ring not merged")
+        else:
+            meta(PID_FLIGHT, "flight ring", 1, "records")
+            shift = (f_origin - t0_unix) * 1e6
+            for rec in fdoc.get("records", []):
+                out.append({
+                    "pid": PID_FLIGHT, "tid": 1, "ph": "i", "s": "t",
+                    "cat": "flight", "name": rec.get("kind", "?"),
+                    "ts": rec.get("t_s", 0.0) * 1e6 + shift,
+                    "args": {k: v for k, v in rec.items()
+                             if k not in ("kind",)},
+                })
+                notes["flight_records"] += 1
+
+    trace_doc = {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "tools/trace_export.py",
+            "run_dir": os.path.abspath(run_dir),
+            "time_origin_unix_s": t0_unix,
+            **notes,
+        },
+    }
+    return trace_doc, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", help="run directory holding "
+                                    f"{TIMELINE_BASENAME} (+ trace.json"
+                                    " / flight.json)")
+    ap.add_argument("--out", default=None,
+                    help=f"output path (default <run_dir>/{MERGED_BASENAME})")
+    ap.add_argument("--check", action="store_true",
+                    help="fail when any admitted request's span chain "
+                         "is incomplete (the CI gate)")
+    args = ap.parse_args(argv)
+
+    try:
+        doc, notes = merge(args.run_dir)
+        _, events = read_timeline(args.run_dir)
+    except FileNotFoundError as e:
+        print(f"no timeline at {args.run_dir}: {e}", file=sys.stderr)
+        return 2
+    out_path = args.out or os.path.join(args.run_dir, MERGED_BASENAME)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
+
+    fails, stats = check_chains(events)
+    print(
+        f"merged {notes['timeline_events']} timeline event(s), "
+        f"{notes['host_spans']} host span event(s), "
+        f"{notes['flight_records']} flight record(s) -> {out_path}"
+    )
+    print(
+        f"requests: {stats['requests']} traced, {stats['admitted']} "
+        f"admitted, {stats['complete']} complete, {stats['rejected']} "
+        f"rejected, {stats['pending']} pending, "
+        f"{stats['drain_handoffs']} drain-handoff(s)"
+    )
+    for note in ("host_spans_note", "flight_note"):
+        if notes.get(note):
+            print(f"note: {notes[note]}", file=sys.stderr)
+    if args.check:
+        if fails:
+            for f_ in fails:
+                print(f"span-chain check FAILED: {f_}", file=sys.stderr)
+            return 1
+        print("span-chain check ok: every admitted request reached "
+              "a terminal serve_done", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
