@@ -867,6 +867,17 @@ def main(argv=None) -> None:
             "enabled": bool(args.obs_dir),
             "serve": serve_cell(record),
         }
+        # graft-mem (PR 17): the runtime memory cell — measured
+        # live-bytes high-water vs the engine's static bill, pool
+        # telemetry, drain-time leak verdict (tools/mem_report.py)
+        from ddl25spring_tpu.obs import memscope
+
+        telemetry["mem"] = (
+            memscope.mem_cell(record["mem"]) if record.get("mem")
+            else {"enabled": False}
+        )
+        if record.get("mem_json"):
+            telemetry["mem"]["mem_json"] = record["mem_json"]
         if compile_report is not None:
             telemetry["compile_report"] = compile_report
             telemetry["lint"] = lint_summary(compile_report)
@@ -1055,6 +1066,24 @@ def main(argv=None) -> None:
     else:
         ft_on_step = None
 
+    # graft-mem (PR 17): the training-loop memory observatory — live
+    # bytes + host RSS sampled once per step through the same on_step
+    # hook the ft machinery rides, with the windowed monotone-growth
+    # detector watching the host side (a growing Python-side resource
+    # fires a flight ``kind="mem"`` violation).  All of it is host
+    # observation: with DDL25_MEMSCOPE=0 (or obs off) the hook reduces
+    # to the ft chain and the compiled step is untouched.
+    from ddl25spring_tpu.obs import memscope
+
+    mem_scope = memscope.MemScope(label="train")
+    if memscope.enabled():
+        _ft_chain = ft_on_step
+
+        def ft_on_step(i, p, o, lval):  # noqa: F811 — deliberate wrap
+            mem_scope.sample(i)
+            if _ft_chain is not None:
+                _ft_chain(i, p, o, lval)
+
     if args.obs_dir:
         lg = obs.MetricsLogger(
             args.obs_dir,
@@ -1081,6 +1110,10 @@ def main(argv=None) -> None:
     # is per-process — so the resumed data cursor drifts by the warmup
     # batches, which a throughput bench tolerates and the pinned
     # equivalence tests in tests/test_ft.py avoid by construction).
+    # the budget anchor is the FIRST sampled step (memscope auto-
+    # baselines): steady-state live bytes on the actual placement —
+    # a post-build probe undercounts DP replication, which only
+    # materializes on the first dispatch
     try:
         if multi is not None:
             def feed_scan():
@@ -1169,6 +1202,14 @@ def main(argv=None) -> None:
                 from ddl25spring_tpu.ft import elastic
 
                 t0r = time.perf_counter()
+                # graft-mem: the survivor-mesh memory step — live bytes
+                # before the reshard vs after the old-mesh state is
+                # dropped rides the reshape record (mem_report gates
+                # its presence on the elastic smoke)
+                mem_before = (
+                    memscope.live_total_bytes()
+                    if memscope.enabled() else None
+                )
                 n_now = meta["n_chips"]
                 target = (
                     fault.arg if fault.kind == "capacity_change"
@@ -1197,6 +1238,11 @@ def main(argv=None) -> None:
                     ),
                 )
                 params, opt_state = state["params"], state["opt_state"]
+                # the freshly-initialized template state from the
+                # rebuild is only a placement donor — holding it for
+                # the rest of the run doubles the survivor mesh's
+                # live bytes (found by the graft-mem step-down gate)
+                del state, p_t, o_t
                 wall = time.perf_counter() - t0r
                 # the faulted step completed and its loss synced before
                 # the post-step fault fired — nothing was in flight, so
@@ -1205,6 +1251,10 @@ def main(argv=None) -> None:
                 reshape_events.append(elastic.record_reshape(
                     old=mesh_now, new=meta["mesh"], wall_s=wall,
                     steps_lost=0, reason=fault.kind, step=fault.step,
+                    **({
+                        "live_bytes_before": mem_before,
+                        "live_bytes_after": memscope.live_total_bytes(),
+                    } if mem_before is not None else {}),
                 ))
                 if saver is not None:
                     saver.note_reshape(
@@ -1384,6 +1434,59 @@ def main(argv=None) -> None:
                     perfscope.write_run_perf(perf_record, args.obs_dir)
             except OSError as e:  # a read-only FS must not kill the line
                 telemetry["perf"]["ledger_error"] = str(e)
+
+    # the runtime-memory cell + artifacts (graft-mem, PR 17): mem.json
+    # in the run dir for obs_report's Memory section, a record:"mem"
+    # ledger row for tools/mem_report.py --check, and the reshape
+    # memory step-downs for the elastic gate
+    telemetry["mem"] = {"enabled": False}
+    if memscope.enabled():
+        try:
+            mesh_axes = {
+                str(ax): int(s) for ax, s in zip(
+                    meta["mesh"].axis_names, meta["mesh"].devices.shape
+                )
+            }
+        except Exception:  # noqa: BLE001 — identity only
+            mesh_axes = {}
+        mem_steps = [
+            {
+                "scope": "train",
+                "reason": ev.get("reason"),
+                "step": ev.get("step"),
+                "live_bytes_before": ev["live_bytes_before"],
+                "live_bytes_after": ev["live_bytes_after"],
+                "step_down_bytes": (
+                    ev["live_bytes_before"] - ev["live_bytes_after"]
+                ),
+            }
+            for ev in reshape_events
+            if ev.get("live_bytes_before") is not None
+        ]
+        mem_record = memscope.mem_record(
+            strategy=meta["layout"],
+            mesh=mesh_axes,
+            scope_cell=mem_scope.cell(),
+            budget=memscope.budget_cell(
+                mem_scope.live_bytes_peak,
+                mem_scope.live_bytes_baseline,
+                source="first_sample_live_bytes",
+            ),
+            reshape_steps=mem_steps or None,
+        )
+        telemetry["mem"] = memscope.mem_cell(mem_record)
+        try:
+            from ddl25spring_tpu.obs import perfscope
+
+            telemetry["mem"]["ledger"] = perfscope.append_ledger(
+                mem_record, args.perf_ledger or perfscope.DEFAULT_LEDGER
+            )
+            if args.obs_dir:
+                telemetry["mem"]["mem_json"] = memscope.write_run_mem(
+                    mem_record, args.obs_dir
+                )
+        except OSError as e:  # a read-only FS must not kill the line
+            telemetry["mem"]["ledger_error"] = str(e)
 
     # drain the last async checkpoint and finalize the manifest BEFORE
     # the end-of-run flight dump, so the dump's meta names the final

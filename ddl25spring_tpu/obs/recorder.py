@@ -262,6 +262,18 @@ class FlightRecorder:
                 k: v for k, v in last_stall.items()
                 if k not in ("seq", "kind")
             }
+        # graft-mem (PR 17): every dump carries the live-array picture
+        # (count, total bytes, top-10 largest with shape/dtype/sharding)
+        # + host RSS, so an OOM-shaped death is diagnosable from
+        # flight.json alone.  Suppressed wholesale: a crash dump must
+        # succeed even with jax half-torn-down.
+        with contextlib.suppress(Exception):
+            from ddl25spring_tpu.obs import memscope
+
+            doc["live_arrays"] = memscope.live_array_summary(top=10)
+            rss = memscope.host_rss_bytes()
+            if rss is not None:
+                doc["host_rss_bytes"] = rss
         if extra:
             doc.update(extra)
         # pid AND thread id: the watchdog's monitor thread and the main

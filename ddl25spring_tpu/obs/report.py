@@ -361,6 +361,20 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
         except (json.JSONDecodeError, OSError) as e:
             out["serve"] = {"error": f"unreadable {SERVE_BASENAME}: {e}"}
 
+    # runtime memory record, when a memscope-wired run dropped one here
+    # (ddl25spring_tpu/obs/memscope.py): live-bytes/RSS high-water vs
+    # the accounted budget, pool telemetry, leak + growth verdicts —
+    # the Memory section below, gated by tools/mem_report.py --check
+    from ddl25spring_tpu.obs.memscope import MEM_BASENAME
+
+    mpath = os.path.join(run_dir, MEM_BASENAME)
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                out["mem"] = json.load(f)
+        except (json.JSONDecodeError, OSError) as e:
+            out["mem"] = {"error": f"unreadable {MEM_BASENAME}: {e}"}
+
     # compile-time analytics, when a bench/CLI run dropped its report here
     # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
     # compiled collectives/HBM/MFU-projection below, one run dir
@@ -623,6 +637,55 @@ def format_report(summary: dict[str, Any]) -> str:
                     + f"  TTFT p95 window {sms(p95r)} vs steady "
                     f"{sms(p95s)}"
                 )
+
+    mem = summary.get("mem")
+    if mem:
+        lines.append("")
+        lines.append(
+            "memory (mem.json — graft-mem runtime observatory; gate "
+            "with tools/mem_report.py --check):"
+        )
+        if mem.get("error"):
+            lines.append(f"  {mem['error']}")
+        else:
+            def mib(v):
+                return (
+                    f"{v / (1 << 20):.1f} MiB"
+                    if isinstance(v, (int, float)) else "n/a"
+                )
+
+            scope = mem.get("memscope") or {}
+            lines.append(
+                f"  live bytes peak {mib(scope.get('live_bytes_peak'))}"
+                f"  host RSS peak {mib(scope.get('rss_bytes_peak'))}"
+                f"  samples {scope.get('samples')}"
+            )
+            b = mem.get("budget") or {}
+            if b.get("available"):
+                lines.append(
+                    f"  budget ({b.get('source')}) "
+                    f"{mib(b.get('budget_bytes'))}  measured/budget "
+                    f"{b.get('ratio')}  within band "
+                    f"(tol {b.get('tolerance')}): {b.get('within_band')}"
+                )
+            pool = mem.get("pool")
+            if pool:
+                lines.append(
+                    f"  kv pool {pool.get('used_pages')}"
+                    f"/{pool.get('n_pages')} pages used "
+                    f"(cache-held {pool.get('cache_held_pages')}, "
+                    f"table-held {pool.get('table_held_pages')})  "
+                    f"fragmentation {pool.get('fragmentation')}"
+                )
+            lines.append(
+                f"  leaked pages {mem.get('leaked_pages', 0)}  "
+                f"growth violations {mem.get('growth_violations', 0)}"
+                + (
+                    f"  reshape step-downs "
+                    f"{len(mem.get('reshape_steps') or [])}"
+                    if mem.get("reshape_steps") is not None else ""
+                )
+            )
 
     c = summary.get("counters", {})
     statics = c.get("static", {})

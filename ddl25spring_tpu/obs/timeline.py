@@ -82,7 +82,13 @@ EVENT_KINDS: dict[str, tuple[str, ...]] = {
     "serve_drain_handoff": ("rid", "from_replica"),
     # -- reshape windows (serve/driver.elastic_serve_run) --
     "reshape_end": ("reason", "t", "t_end"),
+    # -- graft-mem resource samples (obs/memscope.MemScope.sample):
+    # live_bytes required; rss_bytes / pool_used / queue_depth /
+    # tokens_per_s ride along and become Perfetto counter tracks in
+    # tools/trace_export.py --
+    "mem_sample": ("live_bytes",),
     # -- mirrored off the flight ring (FlightRecorder tap) --
+    "mem": (),  # graft-mem growth-detector violations
     "chaos": (),
     "reshape": (),
     "save": (),

@@ -392,12 +392,17 @@ def elastic_serve_run(
     the p95-bounded comparison ``serve_report --check-reshape`` gates.
     """
     from ddl25spring_tpu.ft import elastic
+    from ddl25spring_tpu.obs import memscope
     from ddl25spring_tpu.obs.timeline import timeline
     from ddl25spring_tpu.serve.engine import Request
 
     if tick_s is None:
         tick_s = ab_tick_s(trace, knobs["max_slots"])
     elastic_kinds = ("traffic_spike", "capacity_change", "device_loss")
+    # graft-mem (PR 17): the survivor-mesh memory step-downs — one
+    # entry per retired replica, live bytes before vs after its page
+    # pool is actually dropped (mem_report --check --require-step-down)
+    mem_steps: list[dict] = []
 
     # replica identities are assigned MONOTONICALLY and never reused:
     # ``reps.index(e)`` shifts when a drained replica leaves the list,
@@ -556,6 +561,29 @@ def elastic_serve_run(
                 reps.remove(v)
                 retired.append(v)
                 draining.remove((v, ev))
+                if memscope.enabled():
+                    # the memory step-down: a drained replica's pool
+                    # leaves the device WITH the replica.  Leak-check
+                    # first (the pool must hold exactly its cache-held
+                    # pages), then drop the pool refs and measure the
+                    # live-bytes step.  Retired engines are read only
+                    # for host counters after this point.
+                    before = memscope.live_total_bytes()
+                    leak = v.mem_leak_check()
+                    v.pool = None
+                    v.draft_pool = None
+                    after = memscope.live_total_bytes()
+                    mem_steps.append({
+                        "scope": "serve",
+                        "reason": ev["reason"],
+                        "t": ev["t_end"],
+                        "replica": v.replica_id,
+                        "live_bytes_before": before,
+                        "live_bytes_after": after,
+                        "step_down_bytes": before - after,
+                        "leak_ok": leak["ok"],
+                        "leaked_pages": leak["leaked_pages"],
+                    })
         t += tick_s
         it += 1
         done_feeding = i >= len(arrivals) and not spike_backlog
@@ -619,6 +647,7 @@ def elastic_serve_run(
         "ttft_s_p95_reshape": pct(ttft_window, 95),
         "reshape_window_requests": len(ttft_window),
         "steady_requests": len(ttft_steady),
+        **({"mem_steps": mem_steps} if mem_steps else {}),
         # test hook only (the token-exactness pin): never serialized —
         # run_serve_bench does not pass keep_requests
         **({"_requests": all_done} if keep_requests else {}),
@@ -762,6 +791,40 @@ def run_serve_bench(
             "(DDL25_SERVE_SPEC=1) are not covered yet", stacklevel=2,
         )
 
+    # --- graft-mem (PR 17): measured memory vs the static bill --------
+    # high-water live bytes banded against the engine's exact static
+    # accounting (params + pools), pool telemetry + drain-time leak
+    # check, and the elastic step-downs — mem.json + a record:"mem"
+    # ledger row, gated by tools/mem_report.py --check
+    mem = None
+    from ddl25spring_tpu.obs import memscope
+
+    if memscope.enabled():
+        leak = (
+            eng.mem_leak_check() if eng.drained
+            # a budget-cut ramp still holds live slots: their pages are
+            # working state, not residue — the leak gate only speaks at
+            # drain (the A/B arms and the smoke trace do drain)
+            else {"ok": True, "leaked_pages": 0, "leaks": [],
+                  "skipped": "ramp not drained"}
+        )
+        mem = memscope.mem_record(
+            strategy=f"serve/{model}",
+            mesh={"replicas": 1},
+            scope_cell=eng.memscope.cell(),
+            budget=memscope.budget_cell(
+                eng.memscope.live_bytes_peak, eng.mem_budget_bytes(),
+                source="serve_static_accounting",
+            ),
+            pool=eng.mem_pool_snapshot(),
+            leaks=[leak],
+            reshape_steps=(
+                (reshape or {}).get("mem_steps")
+                if reshape is not None else None
+            ),
+            extra={"profile": spec.profile, "seed": spec.seed},
+        )
+
     record: dict[str, Any] = {
         "record": "serve",
         "ts": time.time(),
@@ -815,6 +878,7 @@ def run_serve_bench(
         "ttft_s": [round(x, 6) for x in eng.ttft_s[:512]],
         "tick_wall_s": [round(x, 6) for x in eng.tick_wall_s[:512]],
         "bench_wall_s": round(time.perf_counter() - t_start, 3),
+        **({"mem": mem} if mem is not None else {}),
     }
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
@@ -824,6 +888,8 @@ def run_serve_bench(
             json.dump(record, f, indent=1, default=str)
         os.replace(tmp, path)
         record["serve_json"] = path
+        if mem is not None:  # mem.json rides next to serve.json
+            record["mem_json"] = memscope.write_run_mem(mem, obs_dir)
     if ledger_path is not None:
         from ddl25spring_tpu.obs.perfscope import append_ledger
 
@@ -831,6 +897,8 @@ def run_serve_bench(
             record["ledger"] = append_ledger(
                 ledger_record(record), ledger_path
             )
+            if mem is not None:  # the record:"mem" trend row
+                append_ledger(mem, ledger_path)
         except OSError as e:  # a read-only FS must not kill the line
             record["ledger_error"] = str(e)
     return record

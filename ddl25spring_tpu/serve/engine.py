@@ -46,7 +46,12 @@ import numpy as np
 from jax import lax
 
 from ddl25spring_tpu.models import decode as decode_mod, llama
-from ddl25spring_tpu.obs import sentinels, spans as _spans, state as _obs_state
+from ddl25spring_tpu.obs import (
+    memscope as _memscope,
+    sentinels,
+    spans as _spans,
+    state as _obs_state,
+)
 from ddl25spring_tpu.obs.timeline import timeline as _timeline
 from ddl25spring_tpu.serve import kv_pages
 from ddl25spring_tpu.serve.prefix import Match, PrefixCache
@@ -830,6 +835,17 @@ class ServeEngine:
         # evaluate "tokens delivered by time B" for ANY budget B from a
         # single drain run instead of re-running per candidate budget
         self.token_log: list[tuple[float, int]] = []
+        # graft-mem (PR 17): the per-engine memory observatory.
+        # Construction is free; sampling gates on memscope.enabled()
+        # AND a trace label (A/B arms stay silent), so disabled runs
+        # are bitwise identical (pinned in tests/test_memscope.py)
+        self.memscope = _memscope.MemScope(
+            label=trace_label or "serve"
+        )
+        # the last rid seated in each device slot — how a drain-time
+        # pool residue is NAMED (memscope.pool_leak_check attribution)
+        self._slot_last_rid: list[int | None] = [None] * max_slots
+        self.mem_leak: dict[str, Any] | None = None
 
     # ---- time ----------------------------------------------------------
 
@@ -961,6 +977,9 @@ class ServeEngine:
         self.tick_wall_s.clear()
         self.ttft_decomp.clear()
         self.done, self.token_log = [], []
+        self.memscope.reset()
+        self._slot_last_rid = [None] * self.max_slots
+        self.mem_leak = None
         self._t0 = time.perf_counter()
 
     def warm_prefill_starts(self, starts) -> None:
@@ -1329,6 +1348,7 @@ class ServeEngine:
             req.prefill_start_t = t_pre
             req.prefill_s = prefill_cost
             self.slots[slot] = req
+            self._slot_last_rid[slot] = req.rid
             self._adopted_pages[slot] = list(m.pages)
             self._cached_pages[slot] = []
             # mirror of the admission bill: full worst case under spec
@@ -1686,7 +1706,94 @@ class ServeEngine:
                 self._run_decode_tick()
             ran = True
         self.token_log.append((self.now(), self.generated_tokens))
+        self._mem_sample()
         return ran
+
+    # ---- graft-mem (PR 17) ---------------------------------------------
+
+    def _mem_sample(self) -> None:
+        """One memory observation per scheduler iteration: live bytes +
+        host RSS into the scope's reservoirs, pool occupancy / queue
+        depth / tokens-per-sec riding the timeline ``mem_sample`` event
+        (the Perfetto counter tracks).  Pool occupancy reads the exact
+        HOST mirror — no device sync on the tick path.  Gated exactly
+        like :meth:`_tl`: no trace label (A/B arms) or obs off means
+        nothing happens."""
+        if self.trace_label is None or not _memscope.enabled():
+            return
+        wall = self.now()
+        self.memscope.sample(
+            self._ticks, vt=wall, engine=self.trace_label,
+            replica=self.replica_id,
+            pool_used=self._host_pages_used(),
+            pool_pages=self.n_pages,
+            queue_depth=len(self.queue),
+            tokens_per_s=(
+                round(self.generated_tokens / wall, 3) if wall > 0
+                else 0.0
+            ),
+        )
+
+    def mem_budget_bytes(self) -> int:
+        """The engine's static memory bill: params + page pool (+ the
+        drafter's params and pool under spec) — exact, from shapes and
+        dtypes.  The budget the runtime live-bytes high-water is banded
+        against (``mem_report --check``): live bytes beyond this by
+        more than the tolerance means device state the accounting
+        never authorized."""
+        def tree_bytes(t) -> int:
+            return sum(
+                int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+                for x in jax.tree.leaves(t)
+            )
+
+        total = tree_bytes(self.params) + tree_bytes(self.pool)
+        if self.spec_k:
+            total += tree_bytes(self.draft_params)
+            total += tree_bytes(self.draft_pool)
+        return total
+
+    def mem_pool_snapshot(self) -> dict[str, Any]:
+        """Device-mask pool telemetry (occupancy, cache-vs-table page
+        split, refcount histogram, free-run fragmentation) — a small
+        host transfer, for drain-time and report-time reads, not the
+        tick path."""
+        held = self.prefix.held_pages if self.prefix is not None else 0
+        return _memscope.pool_snapshot(self.pool, cache_held=held)
+
+    def mem_leak_check(self) -> dict[str, Any]:
+        """The drain-time leak detector: flush any pending releases,
+        then require the pool to hold EXACTLY its cache-held pages.
+        Residue is attributed page by page (table row -> last rid) and
+        fails ``mem_report --check``.  Meaningful when :attr:`drained`
+        (or fully idle); the result is kept on :attr:`mem_leak` for the
+        driver's mem record."""
+        self._flush_releases()
+        held = self.prefix.held_pages if self.prefix is not None else 0
+        out = _memscope.pool_leak_check(
+            self.pool, cache_held_pages=held,
+            slot_rids=self._slot_last_rid,
+        )
+        if self.spec_k:
+            draft = _memscope.pool_leak_check(
+                self.draft_pool, cache_held_pages=0,
+                slot_rids=self._slot_last_rid,
+            )
+            out["draft"] = draft
+            out["ok"] = out["ok"] and draft["ok"]
+            out["leaked_pages"] += draft["leaked_pages"]
+        if not out["ok"]:
+            # a leak is a flight violation too: post-mortems must see
+            # it even when nothing reads mem.json
+            from ddl25spring_tpu.obs.recorder import flight
+
+            flight.record(
+                kind="mem", source="kv_pool_leak",
+                leaked_pages=out["leaked_pages"],
+                leaks=out["leaks"][:8],
+            )
+        self.mem_leak = out
+        return out
 
     def tokens_at(self, t: float) -> int:
         """Cumulative generated tokens delivered by time ``t`` (engine

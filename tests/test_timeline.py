@@ -248,7 +248,10 @@ def test_ttft_decomposition_sums_on_wall_clock_within_tolerance(params):
 def test_request_lifecycle_events_ordered_and_vt_monotone(params):
     timeline.configure(None)
     eng = _run_traced(params, clock="virtual")
-    evs = [e for e in timeline.events() if e.get("engine") == "serve"]
+    # request-lifecycle events only: mem_sample shares the engine tag
+    # but is resource telemetry, not request-scoped (no rid)
+    evs = [e for e in timeline.events()
+           if e.get("engine") == "serve" and e["kind"] != "mem_sample"]
     counts = {}
     for e in evs:
         counts[e["kind"]] = counts.get(e["kind"], 0) + 1

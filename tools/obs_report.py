@@ -28,8 +28,23 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from ddl25spring_tpu.obs.report import format_report, summarize_run  # noqa: E402
 
 
+EXIT_CODES = """\
+exit codes:
+  0  report printed; with --check-health, the run is healthy
+  2  no telemetry at run_dir (missing metrics.jsonl / artifacts)
+  3  --check-health: sentinel violation(s), stall, or flight error
+  4  --check-health: memory violation — mem.json records leaked KV
+     pages, windowed monotone live-bytes growth, or a budget-band
+     breach (graft-mem; see tools/mem_report.py for the full gate)
+"""
+
+
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        epilog=EXIT_CODES,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
     ap.add_argument("run_dir", help="directory holding metrics.jsonl (+ "
                                     "counters.json / trace.json)")
     ap.add_argument("--json", action="store_true",
@@ -77,6 +92,31 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 3
+        mem = summary.get("mem") or {}
+        mem_problems = []
+        if not mem.get("error"):
+            if mem.get("leaked_pages"):
+                mem_problems.append(
+                    f"{mem['leaked_pages']} leaked KV page(s)"
+                )
+            if mem.get("growth_violations"):
+                mem_problems.append(
+                    f"{mem['growth_violations']} live-bytes growth "
+                    f"violation(s)"
+                )
+            b = mem.get("budget") or {}
+            if b.get("available") and b.get("within_band") is False:
+                mem_problems.append(
+                    f"budget band breach (measured/budget "
+                    f"{b.get('ratio')}, tol {b.get('tolerance')})"
+                )
+        if mem_problems:
+            print(
+                f"memory check FAILED for {args.run_dir}: "
+                + "; ".join(mem_problems),
+                file=sys.stderr,
+            )
+            return 4
         print(f"health check ok for {args.run_dir}", file=sys.stderr)
     return 0
 

@@ -27,7 +27,11 @@ https://ui.perfetto.dev or ``chrome://tracing``) with
 - a "subsystems" process: chaos / reshape / autosave / watchdog /
   sentinel tracks, with each elastic reshape window rendered as a
   track-level span (paired ``reshape`` -> ``reshape_end`` events);
-- the host spans and the flight ring alongside, on the same clock.
+- the host spans and the flight ring alongside, on the same clock;
+- a "resources" process of Perfetto counter tracks (``"ph":"C"``) built
+  from graft-mem ``mem_sample`` events: pool occupancy, queue depth,
+  live bytes, host RSS, tokens/sec — same ``t_wall_s`` base, so memory
+  lines up under the request spans (``--min-counter-tracks`` gates it).
 
 ``--check`` is the CI gate: every admitted request's span chain must be
 complete — no orphan ``serve_admit`` without a terminal ``serve_done``
@@ -55,7 +59,16 @@ MERGED_BASENAME = "trace_merged.json"
 # stamped, so the merged view never interleaves two unrelated tracks
 PID_SUBSYS = 1_000_000
 PID_FLIGHT = 1_000_001
+PID_COUNTERS = 1_000_002  # graft-mem resource counter tracks (ph=C)
 PID_REPLICA0 = 1_000_100  # + stable replica ordinal per serve track
+
+# mem_sample fields that become Perfetto counter tracks ("ph":"C"),
+# one track per (field, engine/replica source), all on the shared
+# t_wall_s time base so they line up under the request spans
+_COUNTER_FIELDS = (
+    "live_bytes", "rss_bytes", "pool_used", "queue_depth",
+    "tokens_per_s",
+)
 
 _SUBSYS_TIDS = {
     "chaos": (1, "chaos"),
@@ -301,6 +314,29 @@ def merge(run_dir: str) -> tuple[dict, dict]:
                     "name": f"drain:replica{ev.get('replica')}",
                     "ts": ts(ev), "args": _args_of(ev)})
 
+    # ---- resource counter tracks (graft-mem mem_sample events) -----
+    notes["counter_tracks"] = 0
+    counter_names: set[tuple] = set()
+    for ev in events:
+        if ev.get("kind") != "mem_sample":
+            continue
+        src = ev.get("engine", "run")
+        if ev.get("replica") is not None:
+            src = f"{src}/r{ev['replica']}"
+        for field in _COUNTER_FIELDS:
+            if field not in ev:
+                continue
+            name = f"{field} [{src}]"
+            key = (PID_COUNTERS, name)
+            if key not in counter_names:
+                counter_names.add(key)
+                if len(counter_names) == 1:
+                    meta(PID_COUNTERS, "resources")
+            out.append({"pid": PID_COUNTERS, "tid": 0, "ph": "C",
+                        "cat": "resource", "name": name, "ts": ts(ev),
+                        "args": {field: ev[field]}})
+    notes["counter_tracks"] = len(counter_names)
+
     # ---- host spans (obs/spans.py trace.json) ----------------------
     span_path = os.path.join(run_dir, TRACE_BASENAME)
     notes["host_spans"] = 0
@@ -368,6 +404,11 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="fail when any admitted request's span chain "
                          "is incomplete (the CI gate)")
+    ap.add_argument("--min-counter-tracks", type=int, default=0,
+                    metavar="N",
+                    help="with --check: also fail unless the merged "
+                         "trace carries at least N resource counter "
+                         "tracks (graft-mem mem_sample events)")
     args = ap.parse_args(argv)
 
     try:
@@ -386,7 +427,8 @@ def main(argv=None) -> int:
     print(
         f"merged {notes['timeline_events']} timeline event(s), "
         f"{notes['host_spans']} host span event(s), "
-        f"{notes['flight_records']} flight record(s) -> {out_path}"
+        f"{notes['flight_records']} flight record(s), "
+        f"{notes['counter_tracks']} counter track(s) -> {out_path}"
     )
     print(
         f"requests: {stats['requests']} traced, {stats['admitted']} "
@@ -401,6 +443,13 @@ def main(argv=None) -> int:
         if fails:
             for f_ in fails:
                 print(f"span-chain check FAILED: {f_}", file=sys.stderr)
+            return 1
+        if notes["counter_tracks"] < args.min_counter_tracks:
+            print(
+                f"counter-track check FAILED: {notes['counter_tracks']}"
+                f" counter track(s) < required "
+                f"{args.min_counter_tracks} (no mem_sample telemetry? "
+                f"check DDL25_MEMSCOPE)", file=sys.stderr)
             return 1
         print("span-chain check ok: every admitted request reached "
               "a terminal serve_done", file=sys.stderr)
