@@ -656,6 +656,16 @@ def main(argv=None) -> None:
                     help="skip the speculative spec-on-vs-off A/B "
                          "phase (it also never runs without "
                          "DDL25_SERVE_SPEC=1)")
+    ap.add_argument("--serve-tp", type=int, default=None, metavar="N",
+                    help="TP-shard the serving engine N ways over a "
+                         "1-D model mesh (KV head dim + Megatron "
+                         "params divided per chip; overrides "
+                         "DDL25_SERVE_TP).  N>1 also runs the "
+                         "sharded-vs-dense A/B serve_report "
+                         "--check-tp gates")
+    ap.add_argument("--no-serve-tp-ab", action="store_true",
+                    help="skip the tp-sharded-vs-dense A/B phase (it "
+                         "also never runs at tp=1)")
     ap.add_argument("--compile-report", action="store_true",
                     help="force the pre-device compile report on CPU runs "
                          "(the accelerator path always computes it; see "
@@ -862,6 +872,8 @@ def main(argv=None) -> None:
             skip_ab=args.no_serve_ab,
             skip_prefix_ab=args.no_serve_prefix_ab,
             skip_spec_ab=args.no_serve_spec_ab,
+            skip_tp_ab=args.no_serve_tp_ab,
+            serve_tp=args.serve_tp,
         )
         telemetry: dict = {
             "enabled": bool(args.obs_dir),

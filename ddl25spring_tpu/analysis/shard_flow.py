@@ -423,6 +423,48 @@ def serve_pair_findings(
     return out
 
 
+def stream_rows_findings(
+    reports: dict[str, dict[str, Any]],
+) -> list[Finding]:
+    """The weight-streaming half of H013 (PR 18): a program that
+    declares ``meta["stream_rows_dim"]`` holds its block params as
+    ZeRO-3 ``[L, n, k]`` rows, so every ``params['blocks']`` entry
+    parameter must be partitioned on exactly that dim — a blocks leaf
+    compiled replicated (or split elsewhere) means XLA materialized the
+    full stack per chip and the `param_bytes/n` residency claim is
+    silently void."""
+    from ddl25spring_tpu.analysis.rules import h013_finding
+
+    out = []
+    for name, r in reports.items():
+        meta = r.get("meta") or {}
+        dim = meta.get("stream_rows_dim")
+        if dim is None or "error" in r:
+            continue
+        if int(meta.get("tp") or 1) <= 1:
+            continue  # one chip legitimately compiles rows replicated
+        for p in r.get("entry_params") or []:
+            # op_name metadata escapes quotes — normalize BEFORE the
+            # prefix match or the walk silently sees nothing
+            arg = _norm_arg(p.get("arg")) or ""
+            if not arg.startswith("params['blocks']"):
+                continue
+            dims = (p.get("sharding") or {}).get("partitioned_dims") or []
+            if dims != [dim]:
+                where = arg or p["name"]
+                out.append(h013_finding(
+                    name, op=where, bytes=p.get("bytes"),
+                    message=(
+                        f"streamed blocks leaf {where} is partitioned "
+                        f"on dim(s) {dims} but the engine declares the "
+                        f"ZeRO-3 row split on dim {dim} ([L, n, k]) — "
+                        "the layer stack is resident per chip and the "
+                        "param_bytes/n streaming claim does not hold"
+                    ),
+                ))
+    return out
+
+
 def check_layout_contracts(
     reports: dict[str, dict[str, Any]],
     waivers: list | None = None,
@@ -432,7 +474,7 @@ def check_layout_contracts(
     per-program saved-layout walk is already part of each strategy's
     own rule pass (H013 in the pack), so only the program-PAIR
     contracts emit here.  Waiver-resolved like every finding."""
-    findings = serve_pair_findings(reports)
+    findings = serve_pair_findings(reports) + stream_rows_findings(reports)
     return waivers_mod.apply_waivers(
         findings,
         waivers_mod.load_waivers() if waivers is None else waivers,

@@ -61,6 +61,7 @@ DEFAULT_STRATEGIES = (
     "zero3-rules", "pipeline", "het_pipeline", "tp", "sp", "ep",
     "serve-decode", "serve-prefill", "serve-prefill-cached",
     "serve-draft", "serve-verify",
+    "serve-decode-tp", "serve-prefill-tp", "serve-decode-zero3stream",
 )
 
 

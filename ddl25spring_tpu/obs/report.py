@@ -355,6 +355,7 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
                 "ab": sdoc.get("ab"),
                 "prefix_ab": sdoc.get("prefix_ab"),
                 "spec_ab": sdoc.get("spec_ab"),
+                "tp_ab": sdoc.get("tp_ab"),
                 "reshape": sdoc.get("reshape"),
                 "git_sha": sdoc.get("git_sha"),
             }
@@ -559,14 +560,32 @@ def format_report(summary: dict[str, Any]) -> str:
                     f"{sms(dec.get('first_decode_s_p95'))}"
                 )
             occ = ramp.get("page_pool_peak_occupancy")
+            # occupancy in PER-CHIP bytes, not just global page counts:
+            # under tp the page count is unchanged (pages are a global
+            # logical resource) while each chip holds 1/tp of every
+            # page's head dim — counts alone would read as if sharding
+            # shrank nothing
+            pool_pc = ramp.get("pool_bytes_per_chip")
             lines.append(
                 f"  page pool peak {ramp.get('page_pool_peak_pages')}"
                 f"/{ramp.get('page_pool_pages')} pages"
                 + (f" ({occ * 100:.1f}%)" if isinstance(
                     occ, (int, float)) else "")
+                + (f"  {pool_pc / 1024:.1f} KiB/chip" if isinstance(
+                    pool_pc, (int, float)) else "")
                 + f"  queue depth max {ramp.get('queue_depth_max')}"
                 + f"  pool-ok failures {ramp.get('pool_ok_failures')}"
             )
+            tp = ramp.get("tp")
+            if isinstance(tp, int) and tp > 1:
+                param_pc = ramp.get("param_bytes_per_chip")
+                lines.append(
+                    f"  tp {tp}"
+                    + (" (weight streaming)" if ramp.get("weight_stream")
+                       else "")
+                    + (f"  params {param_pc / 1024:.1f} KiB/chip"
+                       if isinstance(param_pc, (int, float)) else "")
+                )
             prefix = ramp.get("prefix") or {}
             if prefix.get("enabled"):
                 hit = ramp.get("prefix_hit_rate")
@@ -621,6 +640,31 @@ def format_report(summary: dict[str, Any]) -> str:
                     f"budget {sab.get('budget_s')} s  (advantage "
                     f"{sab.get('advantage_tokens')}, tokens match "
                     f"{sab.get('tokens_match')})"
+                )
+            tab = sv.get("tp_ab")
+            if tab:
+                # ledger cells flatten the arms; the raw serve.json
+                # record nests them under sharded/dense — accept both
+                shard_b = tab.get("tp_mem_budget_bytes_per_chip")
+                if shard_b is None:
+                    shard_b = (tab.get("sharded") or {}).get(
+                        "mem_budget_bytes_per_chip")
+                dense_b = tab.get("dense_mem_budget_bytes_per_chip")
+                if dense_b is None:
+                    dense_b = (tab.get("dense") or {}).get(
+                        "mem_budget_bytes_per_chip")
+                lines.append(
+                    f"  tp A/B (tp={tab.get('tp')}) sharded "
+                    f"{tab.get('tp_tokens_at_budget')} vs dense "
+                    f"{tab.get('dense_tokens_at_budget')} tokens at "
+                    f"budget {tab.get('budget_s')} s  (tokens match "
+                    f"{tab.get('tokens_match')}, per-chip "
+                    + (f"{shard_b / 1024:.1f}" if isinstance(
+                        shard_b, (int, float)) else "n/a")
+                    + " vs "
+                    + (f"{dense_b / 1024:.1f} KiB" if isinstance(
+                        dense_b, (int, float)) else "n/a")
+                    + f", shrunk {tab.get('budget_shrunk')})"
                 )
             rsh = sv.get("reshape")
             if rsh:

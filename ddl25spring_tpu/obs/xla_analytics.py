@@ -902,6 +902,30 @@ STRATEGIES: dict[str, dict[str, Any]] = {
         "axes": ("model",), "default_mesh": (2,),
         "kwargs": {"program": "prefill", "start": 4},
     },
+    # the TP-sharded serving trio (PR 18): the same decode/prefill
+    # programs under the tightened per-chip claim — 64 KiB peak-HBM
+    # budgets that only hold because the pool's head dim and the
+    # Megatron splits divide residency by tp (one chip measures
+    # ~83 KiB), all-reduce payloads pinned byte-exact (activation-
+    # sized, UNCHANGED by tp) — and the ZeRO-3 weight-streaming decode,
+    # whose double-buffered per-layer gather is count-pinned
+    # (n_layers x n_buckets) with params/n + one transient layer
+    # resident
+    "serve-decode-tp": {
+        "module": "ddl25spring_tpu.serve.engine",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "decode", "per_chip": True},
+    },
+    "serve-prefill-tp": {
+        "module": "ddl25spring_tpu.serve.engine",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "prefill", "per_chip": True},
+    },
+    "serve-decode-zero3stream": {
+        "module": "ddl25spring_tpu.serve.engine",
+        "axes": ("model",), "default_mesh": (2,),
+        "kwargs": {"program": "decode", "weight_stream": True},
+    },
     # the speculative-decoding pair (PR 13, serve/spec.py): the tiny-
     # LLaMA drafter's k-token proposal scan over its OWN paged pool and
     # the target's single width-(k+1) verify pass — all-reduce-only

@@ -723,6 +723,128 @@ def zero_unshard_llama_params(shards, template):
     return out
 
 
+# ------------------------------------------- serving weight streaming
+#
+# The serve engine's ZeRO-3 weight streaming (PR 18) rides the SAME
+# [L, n, k] per-layer row layout and bucketed gather the zero3-prefetch
+# train step uses — these helpers expose that path for a forward-only
+# consumer: blocks stay resident as rows (param_bytes/n per chip), each
+# decode position gathers ONE full layer at a time (double-buffered by
+# the caller's scan), and the outer leaves (embed/ln_f/unembed) stay
+# replicated because sampling is a global decision over tiny logits.
+
+
+def stream_block_plan(block_tmpl, n: int,
+                      bucket_bytes: int | float = bucketing.AUTO):
+    """The per-LAYER bucket plan streamed serving gathers through: built
+    over one layer's leaf shapes (the stacked ``[L, ...]`` dims dropped),
+    with slot sizes in padded ``[n, k]`` shard rows — identical to the
+    plan :func:`make_zero3_llama_train_step` scans with."""
+    bucket_bytes = bucketing.resolve_bucket_bytes(bucket_bytes)
+    if not bucket_bytes:
+        raise ValueError(
+            "weight streaming is bucketed by construction; bucket_bytes "
+            "must be a positive threshold (DDL25_BUCKET_BYTES=0 cannot "
+            "apply here)"
+        )
+    layer_tmpl = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), block_tmpl
+    )
+    return _row_plan(layer_tmpl, n, bucket_bytes)
+
+
+def zero_stream_llama_params(params, mesh: Mesh, axis: str = "model"):
+    """LLaMA params -> the serving STREAM layout: each stacked
+    ``blocks`` leaf ``[L, ...]`` packs layer-wise into ``[L, n, k]``
+    rows at ``P(None, axis)`` (device ``i`` holds row ``i`` of every
+    layer — ``blocks_bytes/n`` resident per chip), while the outer
+    leaves stay REPLICATED (unlike :func:`zero_shard_llama_params`'s
+    ``[n, k]`` outer shards: serving reads embed/unembed every token
+    and keeps sampling a global decision)."""
+    n = mesh.shape[axis]
+
+    def pack_block(leaf):
+        leaf = jnp.asarray(leaf)
+        L = leaf.shape[0]
+        size = int(np.prod(leaf.shape[1:])) if leaf.shape[1:] else 1
+        k = -(-size // n)
+        flat = jnp.pad(leaf.reshape(L, -1), ((0, 0), (0, n * k - size)))
+        return jax.device_put(
+            flat.reshape(L, n, k), NamedSharding(mesh, P(None, axis))
+        )
+
+    out = {
+        k: (jax.tree.map(pack_block, v) if k == "blocks"
+            else jax.device_put(v, NamedSharding(mesh, P())))
+        for k, v in params.items()
+    }
+    return out
+
+
+def stream_param_specs(params, axis: str = "model"):
+    """The shard_map in/out specs matching
+    :func:`zero_stream_llama_params`'s placement: block rows
+    ``P(None, axis)`` (dim 1 of the ``[L, n, k]`` row layout), outer
+    leaves replicated."""
+    return {
+        k: (jax.tree.map(lambda _: P(None, axis), v) if k == "blocks"
+            else jax.tree.map(lambda _: P(), v))
+        for k, v in params.items()
+    }
+
+
+def stream_layer_bufs(plan, block_rows, L: int):
+    """Local block rows (``[L, 1, k]`` per leaf inside shard_map) ->
+    one packed ``[L, K_b]`` buffer per bucket, scan-indexable by layer."""
+    leaves = plan.treedef.flatten_up_to(block_rows)
+    return [
+        jnp.concatenate(
+            [leaves[i].reshape(L, -1) for i in idxs], axis=1
+        )
+        for idxs in plan.buckets
+    ]
+
+
+def stream_gather_layer(plan, rows, axis: str, n: int):
+    """One layer's local bucket rows (``[K_b]`` each) -> that layer's
+    FULL param tree: one tiled all-gather per bucket, then the plan's
+    unpack — bit-identical to the original leaves (pad/reshape round
+    trip), which is what keeps streamed decode bitwise equal to the
+    resident-weight program."""
+    bufs = [
+        lax.all_gather(r, axis, tiled=True)
+        .reshape(n, plan.bucket_size(b))
+        for b, r in enumerate(rows)
+    ]
+    return _unpack_full(plan, bufs)
+
+
+def stream_gather_blocks(plan, block_rows, axis: str, n: int):
+    """Reconstruct the ENTIRE stacked blocks tree from local ``[L, 1,
+    k]`` rows — one all-gather per bucket over the ``[L, K_b]`` packed
+    buffers.  The whole stack is TRANSIENT (prefill-scoped): streamed
+    serving uses this for the prompt scan, where gathering per position
+    x per layer would cost ``L x max_prompt_len`` gather rounds."""
+    L = jax.tree.leaves(block_rows)[0].shape[0]
+    bufs = [
+        lax.all_gather(b, axis, tiled=False)  # [n, L, K_b]
+        for b in stream_layer_bufs(plan, block_rows, L)
+    ]
+    leaves: list = [None] * plan.n_leaves
+    for b, idxs in enumerate(plan.buckets):
+        for i, off in zip(idxs, plan.offsets(b)):
+            shape = plan.shapes[i]
+            size = int(np.prod(shape)) if shape else 1
+            leaves[i] = (
+                bufs[b][:, :, off:off + plan.sizes[i]]
+                .transpose(1, 0, 2)  # [L, n, k]
+                .reshape(L, -1)[:, :size]
+                .reshape((L,) + tuple(shape))
+                .astype(plan.dtypes[i])
+            )
+    return plan.treedef.unflatten(leaves)
+
+
 def zero_resume_template(
     params_template,
     tx: optax.GradientTransformation,

@@ -83,6 +83,12 @@ def engine_knobs(smoke: bool = False) -> dict[str, Any]:
             if env_int("DDL25_SERVE_SPEC", 0) else 0
         ),
         "draft_layers": env_int("DDL25_SERVE_DRAFT_LAYERS", 1),
+        # TP-sharded serving (PR 18): tp > 1 runs every engine in the
+        # bench under a 1-D model mesh (KV head dim + Megatron params
+        # divided per chip); weight streaming additionally swaps
+        # resident params for ZeRO-3 rows gathered one layer at a time
+        "tp": env_int("DDL25_SERVE_TP", 1),
+        "weight_stream": bool(env_int("DDL25_SERVE_WEIGHT_STREAM", 0)),
     }
 
 
@@ -348,6 +354,90 @@ def spec_ab_compare(
             for rid in common
         ),
         compared_requests=len(common),
+    )
+    return out
+
+
+def tp_ab_compare(
+    params, cfg, trace, knobs: dict[str, Any], *,
+    tick_s: float | None = None, max_steps: int = 20_000,
+    temperature: float = 0.0, sentinel: bool | None = None,
+) -> dict[str, Any]:
+    """TP-sharded vs dense A/B (PR 18): the identical trace through a
+    ``tp = knobs['tp']`` engine (KV head dim + Megatron params divided
+    per chip; ZeRO-3 weight streaming when asked) and the tp=1 dense
+    oracle, both continuous admission on the virtual clock at the same
+    width.  Two verdicts ride out:
+
+    - ``tokens_match`` — every request completed by BOTH arms carries
+      the identical token string (the sharded engine reproduces the
+      dense one bitwise in fp32; the full pin incl. prefix-cache and
+      speculative paths lives in ``tests/test_serve_tp.py``);
+    - ``budget_shrunk`` — the sharded arm's static per-chip residency
+      (:meth:`~ddl25spring_tpu.serve.engine.ServeEngine.
+      mem_budget_bytes`) comes in strictly below the dense arm's — the
+      claim ``serve_report --check-tp`` and ``mem_report --check``
+      gate.
+
+    Throughput is NOT the judge here: on the 2-core CPU sandbox a
+    tp=2 shard pays real cross-"chip" overhead for divided FLOPs the
+    host can't bank, so the wall numbers are reported, never gated."""
+    t = int(knobs.get("tp") or 1)
+    if t <= 1:
+        raise ValueError("tp_ab_compare needs knobs['tp'] > 1")
+    if tick_s is None:
+        tick_s = ab_tick_s(trace, knobs["max_slots"])
+    out: dict[str, Any] = {"tp": t}
+    engines = {}
+    budgets = {}
+    for arm, arm_tp in (("sharded", t), ("dense", 1)):
+        e = _build_engine(
+            params, cfg, knobs, admission="continuous", clock="virtual",
+            tick_s=tick_s, temperature=temperature, sentinel=sentinel,
+            prefill_batch=knobs["max_slots"], tp=arm_tp,
+            weight_stream=(
+                bool(knobs.get("weight_stream")) if arm_tp > 1 else False
+            ),
+            trace_label=None,
+        )
+        m = e.run(trace, max_steps=max_steps)
+        engines[arm] = e
+        budgets[arm] = e.mem_budget_bytes()
+        out[arm] = {
+            "drain_wall_s": m["wall_s"],
+            "ticks": m["ticks"],
+            "prefills": m["prefills"],
+            "generated_tokens": m["generated_tokens"],
+            "completed": m["completed"],
+            "rejected": m["rejected"],
+            "tokens_per_sec_per_chip": m["tokens_per_sec_per_chip"],
+            "mem_budget_bytes_per_chip": budgets[arm],
+            **({
+                "pool_bytes_per_chip": m.get("pool_bytes_per_chip"),
+                "param_bytes_per_chip": m.get("param_bytes_per_chip"),
+                "weight_stream": m.get("weight_stream"),
+            } if arm_tp > 1 else {}),
+        }
+    budget = round(
+        (out["sharded"]["drain_wall_s"] + out["dense"]["drain_wall_s"])
+        / 2, 6,
+    )
+    streams = {
+        arm: {r.rid: list(r.tokens) for r in e.done}
+        for arm, e in engines.items()
+    }
+    common = set(streams["sharded"]) & set(streams["dense"])
+    out.update(
+        budget_s=budget,
+        tick_s=tick_s,
+        tp_tokens_at_budget=engines["sharded"].tokens_at(budget),
+        dense_tokens_at_budget=engines["dense"].tokens_at(budget),
+        tokens_match=all(
+            streams["sharded"][rid] == streams["dense"][rid]
+            for rid in common
+        ),
+        compared_requests=len(common),
+        budget_shrunk=budgets["sharded"] < budgets["dense"],
     )
     return out
 
@@ -670,6 +760,8 @@ def run_serve_bench(
     skip_ab: bool = False,
     skip_prefix_ab: bool = False,
     skip_spec_ab: bool = False,
+    skip_tp_ab: bool = False,
+    serve_tp: int | None = None,
 ) -> dict[str, Any]:
     """The whole serving bench; returns the BENCH record (one JSON line
     with ``telemetry.serve``).  ``budget_s`` bounds the wall-clock ramp
@@ -689,6 +781,8 @@ def run_serve_bench(
     model = model or ("tiny" if smoke else "ref")
     cfg = serve_model(model)
     knobs = engine_knobs(smoke=smoke)
+    if serve_tp is not None:  # bench.py --serve-tp over the env knob
+        knobs["tp"] = int(serve_tp)
     traffic_defaults = SMOKE_TRAFFIC if smoke else {
         "duration_s": 30.0, "rate_rps": 8.0, "profile": "ramp", "seed": 0,
     }
@@ -763,6 +857,15 @@ def run_serve_bench(
                 params, cfg, trace, knobs, sentinel=sentinel,
             )
 
+    # --- tp-sharded vs dense A/B: virtual clock, deterministic --------
+    tp_ab = None
+    if not skip_tp_ab and int(knobs.get("tp") or 1) > 1:
+        with spans.span("serve.tp_ab", cat="serve"):
+            tp_ab = tp_ab_compare(
+                params, cfg, trace, knobs,
+                temperature=temperature, sentinel=sentinel,
+            )
+
     # --- elastic replica reshaping (PR 14): armed chaos only ----------
     # DDL25_CHAOS=traffic_spike@k / capacity_change@k:N / device_loss@k
     # drives replica scale-up/down with page-pool handoff on the
@@ -810,10 +913,31 @@ def run_serve_bench(
         )
         mem = memscope.mem_record(
             strategy=f"serve/{model}",
-            mesh={"replicas": 1},
+            # a tp-sharded run is a different measurement than a dense
+            # one (per-chip residency divides) — the mesh dict is part
+            # of mem_report's trend key, so sharded rows never gate
+            # unsharded history (absent at tp=1: old keys must not
+            # shift)
+            mesh={"replicas": 1,
+                  **({"tp": eng.tp} if eng.tp > 1 else {})},
             scope_cell=eng.memscope.cell(),
+            # memscope live-bytes are GLOBAL logical bytes (a fake-
+            # device shard set still materializes every logical buffer
+            # on the host), so the band compares against the global
+            # bill; the PER-CHIP bill — the quantity tp divides — is
+            # what mem_budget_bytes() defaults to and what --check-tp
+            # gates through the tp_ab cell.  At tp > 1 the engine's
+            # sharded placement is a SECOND logical allocation next to
+            # the bench's dense host copy (kept alive for the A/B
+            # oracle arms), so the static bill covers both.
             budget=memscope.budget_cell(
-                eng.memscope.live_bytes_peak, eng.mem_budget_bytes(),
+                eng.memscope.live_bytes_peak,
+                eng.mem_budget_bytes(per_chip=False) + (
+                    sum(
+                        x.size * x.dtype.itemsize
+                        for x in jax.tree.leaves(params)
+                    ) if eng.tp > 1 else 0
+                ),
                 source="serve_static_accounting",
             ),
             pool=eng.mem_pool_snapshot(),
@@ -855,6 +979,15 @@ def run_serve_bench(
                 "draft_layers": knobs["draft_layers"],
             } if knobs.get("spec_k") else {}),
             **({"max_new_jitter": jitter} if jitter else {}),
+            # tp enters the key ONLY when sharded (PR 18) — same
+            # discipline as the spec keys: pre-PR-18 rows' key strings
+            # must not shift, and sharded runs trend separately from
+            # dense history
+            **({
+                "tp": knobs["tp"],
+                **({"weight_stream": True}
+                   if knobs.get("weight_stream") else {}),
+            } if int(knobs.get("tp") or 1) > 1 else {}),
             # an elastic run (replica reshaping armed) is a different
             # measurement context than a plain ramp — keyed apart so
             # --check-reshape's "latest row" can never be a plain run
@@ -872,6 +1005,7 @@ def run_serve_bench(
         **({"ab": ab} if ab is not None else {}),
         **({"prefix_ab": prefix_ab} if prefix_ab is not None else {}),
         **({"spec_ab": spec_ab} if spec_ab is not None else {}),
+        **({"tp_ab": tp_ab} if tp_ab is not None else {}),
         **({"reshape": reshape} if reshape is not None else {}),
         # bounded raw samples for serve_report's histogram (the summary
         # percentiles above are what the gates read)
@@ -941,6 +1075,13 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
         "acceptance_rate": ramp.get("acceptance_rate"),
         "draft_tokens_accepted": ramp.get("draft_tokens_accepted"),
         "draft_tokens_rejected": ramp.get("draft_tokens_rejected"),
+        # TP-sharded serving (PR 18): shard count + measured per-chip
+        # residency (what divides under tp — the trend the shrink gate
+        # reads)
+        "tp": ramp.get("tp"),
+        "weight_stream": ramp.get("weight_stream"),
+        "pool_bytes_per_chip": ramp.get("pool_bytes_per_chip"),
+        "param_bytes_per_chip": ramp.get("param_bytes_per_chip"),
     }
     ab = record.get("ab")
     if ab:
@@ -958,6 +1099,9 @@ def ledger_record(record: dict[str, Any]) -> dict[str, Any]:
     sab = record.get("spec_ab")
     if sab:
         out["spec_ab"] = _spec_ab_cell(sab)
+    tab = record.get("tp_ab")
+    if tab:
+        out["tp_ab"] = _tp_ab_cell(tab)
     rsh = record.get("reshape")
     if rsh:
         out["reshape"] = _reshape_cell(rsh)
@@ -1042,6 +1186,37 @@ def _spec_ab_cell(sab: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def _tp_ab_cell(tab: dict[str, Any]) -> dict[str, Any]:
+    """The TP A/B summary both the ledger row and telemetry.serve
+    carry — what ``serve_report --check-tp`` gates."""
+    tp_arm = tab.get("sharded") or {}
+    dense_arm = tab.get("dense") or {}
+    return {
+        "tp": tab.get("tp"),
+        "budget_s": tab.get("budget_s"),
+        "tp_tokens_at_budget": tab.get("tp_tokens_at_budget"),
+        "dense_tokens_at_budget": tab.get("dense_tokens_at_budget"),
+        "tokens_match": tab.get("tokens_match"),
+        "compared_requests": tab.get("compared_requests"),
+        "budget_shrunk": tab.get("budget_shrunk"),
+        "tp_mem_budget_bytes_per_chip": tp_arm.get(
+            "mem_budget_bytes_per_chip"
+        ),
+        "dense_mem_budget_bytes_per_chip": dense_arm.get(
+            "mem_budget_bytes_per_chip"
+        ),
+        "tp_tokens_per_sec_per_chip": tp_arm.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "dense_tokens_per_sec_per_chip": dense_arm.get(
+            "tokens_per_sec_per_chip"
+        ),
+        "pool_bytes_per_chip": tp_arm.get("pool_bytes_per_chip"),
+        "param_bytes_per_chip": tp_arm.get("param_bytes_per_chip"),
+        "weight_stream": tp_arm.get("weight_stream"),
+    }
+
+
 def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
     """The ``telemetry.serve`` BENCH cell — every contract key the CI
     smoke asserts (tokens/sec/chip, TTFT + per-token p50/p95, admission
@@ -1074,6 +1249,10 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
         "draft_tokens_accepted": ramp.get("draft_tokens_accepted"),
         "draft_tokens_rejected": ramp.get("draft_tokens_rejected"),
         "spec": ramp.get("spec"),
+        "tp": ramp.get("tp"),
+        "weight_stream": ramp.get("weight_stream"),
+        "pool_bytes_per_chip": ramp.get("pool_bytes_per_chip"),
+        "param_bytes_per_chip": ramp.get("param_bytes_per_chip"),
     }
     ab = record.get("ab")
     if ab:
@@ -1092,6 +1271,9 @@ def serve_cell(record: dict[str, Any]) -> dict[str, Any]:
     sab = record.get("spec_ab")
     if sab:
         cell["spec_ab"] = _spec_ab_cell(sab)
+    tab = record.get("tp_ab")
+    if tab:
+        cell["tp_ab"] = _tp_ab_cell(tab)
     rsh = record.get("reshape")
     if rsh:
         cell["reshape"] = _reshape_cell(rsh)

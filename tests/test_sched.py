@@ -434,8 +434,9 @@ def test_every_registered_strategy_carries_a_sched_report():
     assert set(DEFAULT_STRATEGIES) == set(xa.STRATEGIES)
     # 14 training + 2 serving (PR 10) + the cached-prefill variant
     # (PR 11) + the 2 partition-rule-table strategies (PR 12) + the
-    # speculative draft/verify pair (PR 13)
-    assert len(DEFAULT_STRATEGIES) == 21
+    # speculative draft/verify pair (PR 13) + the TP serving trio
+    # (PR 18: tp decode/prefill + zero3 weight streaming)
+    assert len(DEFAULT_STRATEGIES) == 24
     for name in DEFAULT_STRATEGIES:
         r = cached_strategy_report(name)
         s = r.get("sched")

@@ -356,16 +356,20 @@ def test_prefix_cache_survives_eviction_then_readmit(params):
     assert_pool_invariants(eng)
 
 
-def test_refcount_pool_invariant_under_interleavings(params):
+@pytest.mark.parametrize("tp", [1, 2])
+def test_refcount_pool_invariant_under_interleavings(params, tp):
     """Satellite: seeded property-style sweep.  Random shared-prefix
     traffic against a TIGHT pool (evictions, COW, backpressure, and
     mid-flight completions all interleave) keeps the refcount pool
     invariant exact at every scheduler step, and a full teardown frees
-    every page (no leak, no double-free)."""
+    every page (no leak, no double-free).  tp=2 (PR 18) runs the
+    identical sweep on the head-dim-sharded pool: the sharing ops'
+    refcount accounting is layout-oblivious, so the invariant holds
+    bit-for-bit on the replicated accounting buffers."""
     for seed in (0, 1, 2):
         rng = np.random.RandomState(seed)
         eng = make_engine(
-            params, n_pages=8, max_slots=2, prefill_batch=2,
+            params, n_pages=8, max_slots=2, prefill_batch=2, tp=tp,
         )
         prefixes = [
             [int(x) for x in rng.randint(1, CFG.vocab_size, size=6)]

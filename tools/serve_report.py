@@ -443,6 +443,66 @@ def check_spec_ab(recs: list[dict]) -> list[str]:
     return fails
 
 
+def check_tp(recs: list[dict]) -> list[str]:
+    """The TP-sharded serving acceptance verdict on the latest row
+    (PR 18): the sharded-vs-dense cell must exist, the sharded arm's
+    static per-chip residency must come in STRICTLY below the dense
+    arm's (the whole point of dividing the KV head dim and the
+    params), and the token streams must match bitwise over at least
+    one compared request — vacuity-guarded exactly like the spec gate
+    (an empty intersection passes ``all()`` for free)."""
+    if not recs:
+        return []
+    latest = recs[-1]
+    tab = latest.get("tp_ab")
+    if not isinstance(tab, dict):
+        return ["latest record carries no TP A/B cell (run with "
+                "--serve-tp N / DDL25_SERVE_TP > 1 and without "
+                "--no-serve-tp-ab to record one)"]
+    # a ledger row carries the flattened cell; a serve.json doc carries
+    # the driver's full output with sharded/dense sub-dicts — both work
+    tp_arm = tab.get("sharded") or {}
+    dense_arm = tab.get("dense") or {}
+    tab = {
+        **tab,
+        "tp_mem_budget_bytes_per_chip": tab.get(
+            "tp_mem_budget_bytes_per_chip",
+            tp_arm.get("mem_budget_bytes_per_chip"),
+        ),
+        "dense_mem_budget_bytes_per_chip": tab.get(
+            "dense_mem_budget_bytes_per_chip",
+            dense_arm.get("mem_budget_bytes_per_chip"),
+        ),
+    }
+    fails: list[str] = []
+    shard = tab.get("tp_mem_budget_bytes_per_chip")
+    dense = tab.get("dense_mem_budget_bytes_per_chip")
+    if not (isinstance(shard, (int, float))
+            and isinstance(dense, (int, float)) and shard < dense):
+        fails.append(
+            f"tp={tab.get('tp')} did not shrink the static per-chip "
+            f"residency: sharded {shard} vs dense {dense} bytes "
+            "(mem_budget_bytes must divide for the sharded engine to "
+            "serve bigger models at all)"
+        )
+    if tab.get("budget_shrunk") is not True:
+        fails.append(
+            f"the driver's budget_shrunk verdict is "
+            f"{tab.get('budget_shrunk')!r}, expected True"
+        )
+    cmp_n = tab.get("compared_requests")
+    if tab.get("tokens_match") is not True or not (
+        isinstance(cmp_n, int) and cmp_n > 0
+    ):
+        fails.append(
+            "the sharded engine did not reproduce the dense oracle "
+            f"token-for-token (tokens_match={tab.get('tokens_match')} "
+            f"over {cmp_n} compared request(s); the comparison must "
+            "cover at least one request)"
+        )
+    return fails
+
+
 def histogram(xs: list[float], *, bins: int = 10, width: int = 40,
               scale: float = 1e3, unit: str = "ms") -> list[str]:
     """ASCII histogram lines (log-ish readable, linear bins)."""
@@ -703,6 +763,12 @@ def main(argv=None) -> int:
                          "draft tokens, a strict virtual-clock win, and "
                          "matching token streams over >= 1 compared "
                          "request (implies --check)")
+    ap.add_argument("--check-tp", action="store_true",
+                    help="also fail when the latest row's TP "
+                         "sharded-vs-dense A/B does not show a strictly "
+                         "smaller per-chip static residency and "
+                         "matching token streams over >= 1 compared "
+                         "request (implies --check)")
     ap.add_argument("--check-reshape", action="store_true",
                     help="also fail when the latest row's elastic "
                          "reshape cell does not show >= 1 driven event, "
@@ -716,7 +782,7 @@ def main(argv=None) -> int:
                          "window vs steady state (default 3.0)")
     args = ap.parse_args(argv)
     if (args.check_ab or args.check_prefix_ab or args.check_spec_ab
-            or args.check_reshape):
+            or args.check_tp or args.check_reshape):
         args.check = True  # a verdict nobody reads is not a gate
 
     if args.run_dir is None and not args.ledger_only:
@@ -759,6 +825,8 @@ def main(argv=None) -> int:
                 fails += check_prefix_ab(recs)
             if args.check_spec_ab:
                 fails += check_spec_ab(recs)
+            if args.check_tp:
+                fails += check_tp(recs)
             if args.check_reshape:
                 fails += check_reshape(recs, args.reshape_ttft_factor)
         if len(recs) < 2:
@@ -768,7 +836,7 @@ def main(argv=None) -> int:
             fails += check_group(recs, args.tolerance, args.window)
         verdicts[key] = {"fails": fails, "note": note}
     if ((args.check_ab or args.check_prefix_ab or args.check_spec_ab
-            or args.check_reshape)
+            or args.check_tp or args.check_reshape)
             and ab_scope is not None and ab_scope not in groups):
         # the run under test never landed in this ledger (custom
         # --ledger path): judge its serve.json directly
@@ -777,6 +845,8 @@ def main(argv=None) -> int:
             fails += check_prefix_ab([doc])
         if args.check_spec_ab:
             fails += check_spec_ab([doc])
+        if args.check_tp:
+            fails += check_tp([doc])
         if args.check_reshape:
             fails += check_reshape([doc], args.reshape_ttft_factor)
         verdicts[ab_scope] = {"fails": fails, "note": None}
@@ -802,6 +872,8 @@ def main(argv=None) -> int:
             ab_note += ", prefix A/B advantage verified"
         if args.check_spec_ab:
             ab_note += ", spec A/B advantage verified"
+        if args.check_tp:
+            ab_note += ", tp shrink + token equality verified"
         if args.check_reshape:
             ab_note += ", reshape handoff verified"
         print(f"\nserve check OK: {len(groups)} key(s) within the "
