@@ -46,7 +46,11 @@ from ddl25spring_tpu.analysis.rules import Finding
 # PR-12 satellite: serve/ joins — the driver/engine resolve every
 # DDL25_SERVE_* knob through utils.config.env_int at the entry point,
 # and this scope keeps raw os.environ reads from creeping back into
-# the compiled prefill/decode build path).
+# the compiled prefill/decode build path; PR-19 satellite: the obs
+# modules grown since — timeline and memscope both gate behavior that
+# serve/ft call sites reach, so their env resolution goes through the
+# boundary too.  ft/elastic.py and serve/spec.py ride the ft/ and
+# serve/ prefixes already.)
 _TRACED_CODE_DIRS = (
     "ddl25spring_tpu/parallel/",
     "ddl25spring_tpu/ops/",
@@ -56,6 +60,8 @@ _TRACED_CODE_DIRS = (
     "ddl25spring_tpu/serve/",
     "ddl25spring_tpu/obs/sentinels.py",
     "ddl25spring_tpu/obs/perfscope.py",
+    "ddl25spring_tpu/obs/timeline.py",
+    "ddl25spring_tpu/obs/memscope.py",
 )
 _DONATE_SCOPE = (
     "ddl25spring_tpu/parallel/",

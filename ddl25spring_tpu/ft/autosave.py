@@ -59,11 +59,14 @@ from __future__ import annotations
 import logging
 import math
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any
 
 import numpy as np
+
+from ddl25spring_tpu.analysis.host_sanitizer import wrap_lock
 
 # manifest I/O lives in ft/manifest.py (pure stdlib — the retry driver
 # and the post-mortem report read it without importing orbax); it is
@@ -142,6 +145,15 @@ class AutoSaver:
         # that clobbered them to null would break the next resume
         self._prior_manifest = read_manifest(self._dir) or {}
         self._seen_violations = sentinels.violation_count()
+        # guards the closed flip and durable-step record: close() runs
+        # from the train loop AND the flight shutdown chain (graft-race
+        # S201).  REENTRANT on purpose — the chain executes inside the
+        # SIGTERM/excepthook handlers, which can land while the main
+        # thread is already inside close() holding this lock; a plain
+        # Lock would be the PR-5 self-deadlock (graft-race S203).
+        self._state_lock = wrap_lock(
+            "autosave._state_lock", threading.RLock()
+        )
         self._closed = False
         self.saves = 0
         self.skipped = 0
@@ -232,8 +244,9 @@ class AutoSaver:
         self._write_manifest()
 
     def _mark_durable(self, step: int) -> None:
-        if self._last_durable is None or step > self._last_durable:
-            self._last_durable = step
+        with self._state_lock:
+            if self._last_durable is None or step > self._last_durable:
+                self._last_durable = step
         flight.annotate(
             ckpt_last_durable_step=self._last_durable,
             ckpt_dir=str(self._dir),
@@ -343,9 +356,10 @@ class AutoSaver:
         """Barrier the in-flight save (bounded), finalize the manifest,
         release orbax.  Idempotent — it runs on the flight recorder's
         shutdown chain, where SIGTERM and atexit may both arrive."""
-        if self._closed:
-            return True
-        self._closed = True
+        with self._state_lock:
+            if self._closed:
+                return True
+            self._closed = True
         flight.unregister_shutdown(self._hook_name)
         drained = self.ckpt.close(
             timeout_s if timeout_s is not None else self.close_timeout_s

@@ -36,6 +36,7 @@ import time
 from collections import deque
 from typing import Any
 
+from ddl25spring_tpu.analysis.host_sanitizer import wrap_lock
 from ddl25spring_tpu.utils.config import env_float
 
 DEFAULT_CAPACITY = 256
@@ -83,8 +84,10 @@ class FlightRecorder:
         # step).  A plain Lock would self-deadlock the preemption path;
         # reentrancy at worst lets the handler observe a half-applied
         # record update (an off-by-one "recorded" count in the dump),
-        # which a dying process tolerates.
-        self._lock = threading.RLock()
+        # which a dying process tolerates.  DDL25_SANITIZE=1 wraps it
+        # in the graft-race order-recording proxy (a no-op pass-through
+        # otherwise).
+        self._lock = wrap_lock("flight._lock", threading.RLock())
         self._records: deque[dict] = deque(maxlen=capacity)
         self._meta: dict[str, Any] = {}
         self._seq = 0

@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from ddl25spring_tpu.analysis.host_sanitizer import wrap_lock
 from ddl25spring_tpu.obs import state
 from ddl25spring_tpu.obs.recorder import _json_safe, flight
 
@@ -113,7 +114,7 @@ class Timeline:
     (:data:`timeline`) serves the whole process, like ``flight``."""
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self._lock = threading.RLock()
+        self._lock = wrap_lock("timeline._lock", threading.RLock())
         self._ring: deque = deque(maxlen=capacity)
         self._counts: dict[str, int] = {}
         self._seq = 0

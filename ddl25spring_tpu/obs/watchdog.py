@@ -31,6 +31,7 @@ import traceback
 import time
 from typing import Any, Callable
 
+from ddl25spring_tpu.analysis.host_sanitizer import wrap_lock
 from ddl25spring_tpu.obs.recorder import (
     flight,
     watchdog_deadline_default,
@@ -100,6 +101,13 @@ class StallWatchdog:
         self.fired = False
         self.fire_count = 0
         self.dump_path: str | None = None
+        # guards the beat/fired transitions: beat() runs on the main
+        # thread, the re-arm and fire run on the monitor — graft-race
+        # S201 caught the unsynchronized test-and-set.  Never held
+        # across the dump (which can block on I/O for seconds).
+        self._state_lock = wrap_lock(
+            "watchdog._state_lock", threading.Lock()
+        )
         self._last_beat = time.perf_counter()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -132,13 +140,15 @@ class StallWatchdog:
         self.stop()
 
     def beat(self) -> None:
-        self._last_beat = time.perf_counter()
-        self.fired = False  # re-arm after a fire
+        with self._state_lock:
+            self._last_beat = time.perf_counter()
+            self.fired = False  # re-arm after a fire
 
     # ---- monitor --------------------------------------------------------
 
     def _idle_s(self) -> float:
-        idle = time.perf_counter() - self._last_beat
+        with self._state_lock:
+            idle = time.perf_counter() - self._last_beat
         if self.source == "flight":
             idle = min(idle, flight.seconds_since_beat())
         return idle
@@ -151,14 +161,16 @@ class StallWatchdog:
                 # record doesn't touch the flight clock) re-arms so the
                 # next stall in the same run fires again
                 if idle < self.deadline_s:
-                    self.fired = False
+                    with self._state_lock:
+                        self.fired = False
                 continue
             if idle >= self.deadline_s:
                 self._fire()
 
     def _fire(self) -> None:
-        self.fired = True
-        self.fire_count += 1
+        with self._state_lock:
+            self.fired = True
+            self.fire_count += 1
         info = {
             "watchdog": self.name,
             "deadline_s": self.deadline_s,

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ddl25spring_tpu.analysis import host_sanitizer as _sanitizer
 from ddl25spring_tpu.models import decode as decode_mod, llama
 from ddl25spring_tpu.obs import (
     memscope as _memscope,
@@ -1250,6 +1251,12 @@ class ServeEngine:
         # pool residue is NAMED (memscope.pool_leak_check attribution)
         self._slot_last_rid: list[int | None] = [None] * max_slots
         self.mem_leak: dict[str, Any] | None = None
+        # graft-race (PR 19): DDL25_SANITIZE=1 asserts the host<->
+        # device page mirror at every step boundary (a device sync —
+        # debug mode only).  Resolved once, through the sanctioned
+        # boundary; off means not a single extra instruction on the
+        # step path (pinned byte-identical in tests/test_host_safety).
+        self._sanitize = _sanitizer.enabled()
 
     # ---- sharding ------------------------------------------------------
 
@@ -1644,7 +1651,12 @@ class ServeEngine:
     def _adopt_batch(self, batch: list[tuple[int, Request, Match]]) -> None:
         """Seat every matched prefix before the suffix prefill: full
         pages by reference, the partial tail page as a COW copy
-        (``kv_pages.adopt_prefix``)."""
+        (``kv_pages.adopt_prefix``) — and bill the adopted pages to the
+        host mirror in the same breath (graft-race S204: the device
+        refcount bump and its host twin must not live in different
+        methods)."""
+        for slot, _req, m in batch:
+            self._adopted_pages[slot] = list(m.pages)
         if not any(m.matched for _, _, m in batch):
             return
         B = self.prefill_batch
@@ -1781,7 +1793,8 @@ class ServeEngine:
             req.prefill_s = prefill_cost
             self.slots[slot] = req
             self._slot_last_rid[slot] = req.rid
-            self._adopted_pages[slot] = list(m.pages)
+            # _adopted_pages[slot] was billed by _adopt_batch (S204:
+            # same method as the device refcount bump)
             self._cached_pages[slot] = []
             # mirror of the admission bill: full worst case under spec
             # (the drafter pool's share-less need), discounted otherwise
@@ -2139,6 +2152,8 @@ class ServeEngine:
             ran = True
         self.token_log.append((self.now(), self.generated_tokens))
         self._mem_sample()
+        if self._sanitize:  # graft-race: live S204 mirror assertion
+            _sanitizer.check_serve_mirror(self)
         return ran
 
     # ---- graft-mem (PR 17) ---------------------------------------------

@@ -23,6 +23,12 @@ Two passes share one findings model and one waiver file
 - **source pass** — AST rules S101-S103 over the installable package:
   env reads in traced-code modules, jit call sites without a donation
   decision, raw numpy inside traced functions.
+- **host-safety pass** (``--host-safety``) — graft-race S201-S205 over
+  the host surfaces (``obs/``, ``ft/``, ``serve/``, ``bench.py``,
+  ``tools/``): cross-context attribute races, lock-order inversions,
+  signal-handler-unsafe operations, host<->device mirror drift against
+  the declared MIRRORS contract, and unbounded blocking on shutdown
+  paths (``ddl25spring_tpu/analysis/host_safety.py``).
 
 ``--check`` exits non-zero on any *unwaived* finding (or any strategy
 that fails to compile when strategies were requested) — the
@@ -132,9 +138,31 @@ def _fmt_shard_flow(summary: dict) -> list[str]:
     return lines
 
 
+def _fmt_host_safety(inv, findings) -> list[str]:
+    """The --host-safety block: the execution-context inventory one-
+    liner + every S201-S205 finding (analysis/host_safety.py)."""
+    from ddl25spring_tpu.analysis.engine import summarize
+
+    s = summarize(findings)
+    inv_s = inv.summary()
+    entries = ", ".join(
+        f"{k}={v}" for k, v in sorted(inv_s["entry_points"].items())
+    ) or "none"
+    lines = [
+        f"host-safety (graft-race): {s['findings']} finding(s), "
+        f"{s['unwaived']} unwaived  "
+        f"[{inv_s['files']} files, {inv_s['functions']} functions, "
+        f"{len(inv_s['locks'])} declared lock(s), entries: {entries}, "
+        f"{inv_s['mirror_contracts']} mirror contract(s)]"
+    ]
+    lines.extend(_fmt_finding(f.to_dict()) for f in findings)
+    return lines
+
+
 def _render_table(
     src_findings, hlo_reports, sched: bool = False,
     shard_flow: dict | None = None,
+    host_inv=None, host_findings=None,
 ) -> str:
     from ddl25spring_tpu.analysis.engine import summarize
 
@@ -146,6 +174,8 @@ def _render_table(
             f"{s['unwaived']} unwaived"
         )
         blocks.extend(_fmt_finding(f.to_dict()) for f in src_findings)
+    if host_findings is not None:
+        blocks.extend(_fmt_host_safety(host_inv, host_findings))
     for name, r in (hlo_reports or {}).items():
         if "error" in r:
             blocks.append(f"strategy {name}: FAILED to compile: {r['error']}")
@@ -214,6 +244,12 @@ def main(argv=None) -> int:
                          "agreement, on top of the per-strategy "
                          "H011-H013 the rule pass always runs "
                          "(analysis/shard_flow.py)")
+    ap.add_argument("--host-safety", action="store_true",
+                    help="run the graft-race pass (S201-S205): the "
+                         "execution-context inventory + concurrency/"
+                         "signal-safety/mirror rules over obs/, ft/, "
+                         "serve/, bench.py and tools/ "
+                         "(analysis/host_safety.py)")
     ap.add_argument("--no-src", action="store_true",
                     help="skip the source (AST) pass")
     ap.add_argument("--waivers", default=None, metavar="TOML",
@@ -237,6 +273,14 @@ def main(argv=None) -> int:
         src_findings = apply_waivers(
             source_lint.lint_repo(args.root), waivers
         )
+
+    host_inv = None
+    host_findings = None
+    if args.host_safety:
+        from ddl25spring_tpu.analysis import host_safety
+
+        host_inv, host_findings = host_safety.lint_repo(args.root)
+        host_findings = apply_waivers(host_findings, waivers)
 
     hlo_reports: dict = {}
     if args.strategy:
@@ -324,6 +368,8 @@ def main(argv=None) -> int:
         by_rule: dict = {}
         for f in src_findings or []:
             by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        for f in host_findings or []:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         for r in hlo_reports.values():
             for f in r.get("findings") or []:
                 by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
@@ -342,11 +388,17 @@ def main(argv=None) -> int:
         }
         if shard_flow_doc is not None:
             doc["shard_flow"] = shard_flow_doc
+        if host_findings is not None:
+            doc["host_safety"] = {
+                "inventory": host_inv.summary(),
+                "findings": [f.to_dict() for f in host_findings],
+            }
         print(json.dumps(doc, indent=1, default=str))
     else:
         print(_render_table(
             src_findings, hlo_reports, sched=args.sched or args.check,
             shard_flow=shard_flow_doc,
+            host_inv=host_inv, host_findings=host_findings,
         ))
 
     if args.check:
@@ -355,6 +407,11 @@ def main(argv=None) -> int:
             if not f.waived:
                 print(f"CHECK FAIL source: {f.rule} {f.source} {f.op}",
                       file=sys.stderr)
+                bad += 1
+        for f in host_findings or []:
+            if not f.waived:
+                print(f"CHECK FAIL host-safety: {f.rule} {f.source} "
+                      f"{f.op}", file=sys.stderr)
                 bad += 1
         for name, r in hlo_reports.items():
             if "error" in r:
@@ -385,6 +442,8 @@ def main(argv=None) -> int:
             "source pass clean" if src_findings is not None
             else "source pass SKIPPED (--no-src)"
         )
+        if host_findings is not None:
+            src_msg += ", host-safety pass clean"
         print(f"graft-lint OK: {src_msg}, {len(hlo_reports)} strategy "
               "HLO pass(es) clean (waivers applied)", file=sys.stderr)
     return 0
