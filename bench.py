@@ -313,6 +313,7 @@ def run_with_retries(
     compile_report: dict | None = None,
     ckpt_dir: str | None = None,
     flight_path: str | None = None,
+    ledger_path: str | None = None,
 ) -> None:
     """Re-exec the bench in fresh subprocesses until one prints a JSON
     line without an ``error`` field.  Fresh processes because a failed
@@ -343,14 +344,71 @@ def run_with_retries(
     between each death's last flight-recorded step and the durable
     checkpoint it restarted from).  ``compile_report`` (computed by the
     parent BEFORE any device contact) rides ``telemetry.compile_report``
-    on the same line, success or failure."""
+    on the same line, success or failure.
+
+    **Run lineage** (graft-goodput, PR 20): the parent mints ONE
+    ``lineage_id`` here and hands it to every attempt through the
+    sanctioned env boundary (``DDL25_LINEAGE`` / ``DDL25_ATTEMPT``) —
+    all attempts of one retry loop, resumed or fresh, are the same
+    lineage, and each stamps it into its flight meta and timeline
+    header.  Each failure record carries the lineage id plus the dead
+    attempt's goodput facts priced off its flight dump (the next
+    attempt overwrites the file, so failure time is the only chance);
+    after the loop, :func:`ddl25spring_tpu.obs.goodput.merge_lineage`
+    folds every attempt onto one wall axis, rewrites the run's
+    ``goodput.json`` with the lineage view, appends the
+    ``record:"goodput"`` ledger row, and rides ``telemetry.goodput``
+    on the final line."""
     import subprocess
     import time
 
     from ddl25spring_tpu.ft.manifest import latest_durable_step
+    from ddl25spring_tpu.obs import goodput as goodput_mod
 
     backoff = (60.0, 120.0)
     chaos_spec = os.environ.get("DDL25_CHAOS")
+    lineage_id = goodput_mod.mint_lineage_id()
+    run_dir = os.path.dirname(flight_path) if flight_path else None
+
+    def _finish(record: dict) -> dict:
+        """Fold the lineage goodput view into the final line (and the
+        run dir's goodput.json / the ledger) — best-effort: goodput
+        accounting must never cost the bench line itself."""
+        try:
+            final = (
+                goodput_mod.read_run_goodput(run_dir) if run_dir else None
+            )
+            if isinstance(final, dict) and final.get("scope") != (
+                "train_attempt"
+            ):
+                final = None  # stale serve/lineage doc, not this child's
+            merged = goodput_mod.merge_lineage(
+                final, failures, lineage_id=lineage_id
+            )
+            if merged is None:
+                return record
+            if run_dir:
+                goodput_mod.write_run_goodput(merged, run_dir)
+            tel = record.setdefault("telemetry", {"enabled": False})
+            if isinstance(tel, dict):
+                tel["goodput"] = goodput_mod.goodput_cell(merged)
+            if final is not None and merged.get("strategy"):
+                from ddl25spring_tpu.obs import perfscope
+
+                perfscope.append_ledger(
+                    goodput_mod.ledger_row(
+                        merged,
+                        strategy=merged["strategy"],
+                        mesh=merged.get("mesh"),
+                        host=perfscope.host_fingerprint(),
+                    ),
+                    ledger_path or perfscope.DEFAULT_LEDGER,
+                )
+        except Exception as e:  # noqa: BLE001 — observability only
+            print(f"lineage goodput merge failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
+        return record
+
     last: dict = {}
     failures: list[dict] = []
     resume_step: int | None = None  # durable step the NEXT attempt resumes from
@@ -365,7 +423,14 @@ def run_with_retries(
         if resume_step is not None:
             child_argv += ["--resume-from", ckpt_dir]
             resume_count += 1
-        env = dict(os.environ, DDL25_BENCH_CHILD="1")
+        env = dict(
+            os.environ,
+            DDL25_BENCH_CHILD="1",
+            **{
+                goodput_mod.ENV_LINEAGE: lineage_id,
+                goodput_mod.ENV_ATTEMPT: str(i + 1),
+            },
+        )
         t0 = time.perf_counter()
         rc = None
         try:
@@ -402,9 +467,9 @@ def run_with_retries(
                     {"resumes": resume_count, "total_steps_lost": steps_lost}
                     if resume_count else None
                 )
-                print(json.dumps(attach_parent_telemetry(
+                print(json.dumps(_finish(attach_parent_telemetry(
                     parsed, failures, compile_report, resume=resume
-                )))
+                ))))
                 return
             last = parsed or {
                 "metric": "cifar10_resnet18_dppp_samples_per_sec_per_chip",
@@ -435,13 +500,25 @@ def run_with_retries(
         # carrying the stamp of one we already billed is a STALE file (a
         # later attempt died before dumping) — don't bill it twice.
         resume_step = latest_durable_step(ckpt_dir) if ckpt_dir else None
-        if resume_step is not None:
-            stamp, died_at = _flight_dump_facts(flight_dump)
-            if stamp is None or stamp != seen_dump_stamp:
-                if stamp is not None:
-                    seen_dump_stamp = stamp
-                if died_at is not None:
-                    steps_lost += max(0, died_at - resume_step)
+        stamp, died_at = _flight_dump_facts(flight_dump)
+        dump_fresh = stamp is None or stamp != seen_dump_stamp
+        if stamp is not None and dump_fresh:
+            seen_dump_stamp = stamp
+        if resume_step is not None and dump_fresh and died_at is not None:
+            steps_lost += max(0, died_at - resume_step)
+        # price the dead attempt for the lineage goodput merge NOW —
+        # the relaunched child truncates this exact file.  Same
+        # staleness rule as steps_lost: a dump we already billed must
+        # not vouch for a second death's useful work.
+        attempt_goodput = None
+        if flight_dump and dump_fresh:
+            try:
+                with open(flight_dump) as f:
+                    attempt_goodput = goodput_mod.failed_attempt_facts(
+                        json.load(f), resume_step
+                    )
+            except (OSError, ValueError):
+                attempt_goodput = None
         # preemption skips the backoff: the accelerator is healthy, the
         # process was just told to die — relaunch (and resume) now.
         # Armed chaos skips it too: every chaos death is SIMULATED (the
@@ -454,6 +531,7 @@ def run_with_retries(
         ) if i + 1 < attempts else 0.0
         rec = {
             "record": "bench_retry_failure",
+            "lineage_id": lineage_id,
             "attempt": i + 1,
             "attempts_left": attempts - i - 1,
             "error": err_s,
@@ -462,6 +540,7 @@ def run_with_retries(
             "wall_s": round(time.perf_counter() - t0, 3),
             "backoff_s": delay,
             **({"flight_dump": flight_dump} if flight_dump else {}),
+            **({"goodput": attempt_goodput} if attempt_goodput else {}),
             **(
                 {"resumed_from_step": prev_resume}
                 if prev_resume is not None else {}
@@ -476,9 +555,9 @@ def run_with_retries(
         {"resumes": resume_count, "total_steps_lost": steps_lost}
         if resume_count else None
     )
-    print(json.dumps(attach_parent_telemetry(
+    print(json.dumps(_finish(attach_parent_telemetry(
         last, failures, compile_report, resume=resume
-    )))
+    ))))
 
 
 def fedavg_secondary(n_rounds: int = 10) -> dict:
@@ -797,6 +876,7 @@ def main(argv=None) -> None:
                 os.path.join(args.obs_dir, "flight.json")
                 if args.obs_dir else None
             ),
+            ledger_path=args.perf_ledger,
         )
         return
 
@@ -816,6 +896,22 @@ def main(argv=None) -> None:
     flight.annotate(
         driver="bench",
         argv=list(argv if argv is not None else sys.argv[1:]),
+    )
+
+    # graft-goodput (PR 20): this process's place in its run lineage.
+    # A retry child inherits the parent's id through the env boundary
+    # (so a resumed attempt carries the SAME lineage_id); an in-process
+    # run (plain CPU smoke, serve) is its own one-attempt lineage.
+    from ddl25spring_tpu.obs import goodput as goodput_mod
+
+    lineage_id, attempt = goodput_mod.lineage_from_env()
+    own_lineage = lineage_id is None  # nobody upstream will merge for us
+    if own_lineage:
+        lineage_id = goodput_mod.mint_lineage_id()
+    flight.annotate(lineage_id=lineage_id, attempt=attempt)
+    lineage_meta = {"lineage_id": lineage_id, "attempt": attempt}
+    gp_meter = goodput_mod.GoodputMeter(
+        lineage_id, attempt, t0_perf=t_main0
     )
 
     devices, err, probe_dump = probe_devices(
@@ -857,7 +953,7 @@ def main(argv=None) -> None:
             # way — pinned in tests/test_timeline.py)
             obs.enable()
             obs.set_recorder(obs.SpanRecorder(process_name="serve"))
-            timeline.configure(run_dir=args.obs_dir)
+            timeline.configure(run_dir=args.obs_dir, meta=lineage_meta)
 
         record = run_serve_bench(
             smoke=args.smoke,
@@ -874,11 +970,22 @@ def main(argv=None) -> None:
             skip_spec_ab=args.no_serve_spec_ab,
             skip_tp_ab=args.no_serve_tp_ab,
             serve_tp=args.serve_tp,
+            lineage=lineage_meta,
         )
         telemetry: dict = {
             "enabled": bool(args.obs_dir),
             "serve": serve_cell(record),
         }
+        # graft-goodput: the SLO-denominated serving goodput cell the
+        # driver computed (attainment, goodput tokens/sec/chip,
+        # availability) — lineage identity rides along so serve lines
+        # group like training lines in the ledger
+        if record.get("goodput"):
+            telemetry["goodput"] = {
+                **lineage_meta, **goodput_mod.goodput_cell(
+                    record["goodput"]
+                ),
+            }
         # graft-mem (PR 17): the runtime memory cell — measured
         # live-bytes high-water vs the engine's static bill, pool
         # telemetry, drain-time leak verdict (tools/mem_report.py)
@@ -948,6 +1055,13 @@ def main(argv=None) -> None:
         obs.enable()
         obs.set_recorder(obs.SpanRecorder(process_name="bench"))
         obs.counters.reset()
+        # graft-goodput: the training run gets the unified timeline too
+        # (serve always had one) — its header names the lineage, and
+        # the flight tap mirrors save/restore/stall/chaos events in,
+        # so one artifact correlates every attempt of a retry lineage
+        from ddl25spring_tpu.obs.timeline import timeline
+
+        timeline.configure(run_dir=args.obs_dir, meta=lineage_meta)
 
     n = len(devices)
     if args.stages:
@@ -988,6 +1102,7 @@ def main(argv=None) -> None:
                 devices, dp, S, M, batch, overlap=args.overlap
             )
     n_chips = meta["n_chips"]
+    gp_meter.chips = n_chips  # windows before a reshape bill this width
     flight.annotate(
         layout=meta["layout"], topology=meta["topology"],
         n_chips=n_chips, batch=batch, scan_steps=K,
@@ -1047,6 +1162,12 @@ def main(argv=None) -> None:
             # the checkpoint read all inside); the elastic path's
             # reshape wall is the in-process counterpart
             recovery_wall_s = round(_time.perf_counter() - t_main0, 3)
+            # goodput: everything from process entry to "restored" is
+            # the relaunch path's recovery bill — one window on the
+            # meter's axis (which is anchored at the same t_main0)
+            gp_meter.add(
+                "recovery", 0.0, gp_meter.now(), reason="relaunch_restore"
+            )
             if start_step:
                 params, opt_state = state["params"], state["opt_state"]
                 ds.cursor = int(state["data_cursor"])
@@ -1061,6 +1182,9 @@ def main(argv=None) -> None:
                 if prev_last is not None:
                     replayed = max(0, prev_last + 1 - start_step)
                     flight.annotate(steps_replayed=replayed)
+                    # the durable-gap steps re-run now: timed_run bills
+                    # their dispatch walls `replayed_steps`, not useful
+                    gp_meter.set_replay_window(start_step, prev_last)
 
         def ft_on_step(i, p, o, lval):
             """timed_run's per-step hook: kill-type chaos first (a fault
@@ -1069,12 +1193,19 @@ def main(argv=None) -> None:
             if chaos is not None:
                 chaos.on_step(i, skip=elastic_skip)
             if saver is not None:
-                saver.maybe_save(
+                # goodput: the save's host-blocking enqueue wall (the
+                # async write itself overlaps training) — billed only
+                # when the cadence gate actually fired
+                t0_save = gp_meter.now()
+                if saver.maybe_save(
                     i,
                     resume_bundle(p, o, data_cursor=ds.cursor,
                                   rng_seed=ds.seed),
                     loss=lval,
-                )
+                ):
+                    gp_meter.add(
+                        "checkpoint_save", t0_save, gp_meter.now(), step=i
+                    )
     else:
         ft_on_step = None
 
@@ -1148,7 +1279,7 @@ def main(argv=None) -> None:
                 max(2, args.warmup // 2),
                 logger=lg, label="hbm-scan", samples_per_step=batch,
                 steps_per_call=K, on_step=ft_on_step,
-                step_offset=start_step,
+                step_offset=start_step, goodput=gp_meter,
             )
             sps_chip = n_disp * K * batch / dt / n_chips
             dt_per_step = dt / (n_disp * K)
@@ -1162,6 +1293,7 @@ def main(argv=None) -> None:
             dt0, params, opt_state = timed_run(
                 step, params, opt_state, ds.feed, args.steps, args.warmup,
                 logger=lg, label="hbm-single", samples_per_step=batch,
+                goodput=gp_meter,
             )
             sps_chip_single = args.steps * batch / dt0 / n_chips
         else:
@@ -1203,6 +1335,7 @@ def main(argv=None) -> None:
                         logger=lg, label="hbm-single",
                         samples_per_step=batch,
                         on_step=ft_on_step, step_offset=seg_start,
+                        goodput=gp_meter,
                     )
                     dt += dt_i
                     chip_s += dt_i * n_chips
@@ -1214,6 +1347,7 @@ def main(argv=None) -> None:
                 from ddl25spring_tpu.ft import elastic
 
                 t0r = time.perf_counter()
+                g0r = gp_meter.now()
                 # graft-mem: the survivor-mesh memory step — live bytes
                 # before the reshard vs after the old-mesh state is
                 # dropped rides the reshape record (mem_report gates
@@ -1256,6 +1390,10 @@ def main(argv=None) -> None:
                 # live bytes (found by the graft-mem step-down gate)
                 del state, p_t, o_t
                 wall = time.perf_counter() - t0r
+                gp_meter.add(
+                    "reshape_window", g0r, g0r + wall,
+                    step=fault.step, reason=fault.kind,
+                )
                 # the faulted step completed and its loss synced before
                 # the post-step fault fired — nothing was in flight, so
                 # steps_lost is 0 by construction (vs the relaunch
@@ -1276,6 +1414,7 @@ def main(argv=None) -> None:
                     )
                 mesh_now = meta["mesh"]
                 n_chips = meta["n_chips"]
+                gp_meter.chips = n_chips  # later windows bill survivor width
                 flight.annotate(
                     layout=meta["layout"], topology=meta["topology"],
                     n_chips=n_chips,
@@ -1316,6 +1455,7 @@ def main(argv=None) -> None:
     dt_s, params, opt_state = timed_run(
         step, params, opt_state, feed.feed, args.steps, stream_warm,
         logger=lg, label="stream", samples_per_step=batch,
+        goodput=gp_meter,
     )
     sps_chip_stream = args.steps * batch / dt_s / n_chips
 
@@ -1323,6 +1463,7 @@ def main(argv=None) -> None:
     dt2, params, opt_state = timed_run(
         step, params, opt_state, feed.feed_fixed, args.steps, args.warmup,
         logger=lg, label="fixed-batch", samples_per_step=batch,
+        goodput=gp_meter,
     )
     sps_chip_fixed = args.steps * batch / dt2 / n_chips
 
@@ -1560,6 +1701,47 @@ def main(argv=None) -> None:
     if args.obs_dir:
         health["flight_dump"] = obs.flight.dump(reason="end_of_run")
     telemetry["health"] = health
+
+    # graft-goodput (PR 20): close this attempt's badput decomposition.
+    # Watchdog stall idle rides as seconds-only (its span overlaps the
+    # step that eventually completed); everything never measured
+    # (imports, FedAvg, the h2d probe, perfscope) is the honest
+    # ``other`` residual.  A retry child's doc is the attempt view the
+    # parent merges into the lineage view; an in-process run (plain CPU
+    # smoke) is its own one-attempt lineage and appends its own ledger
+    # row.
+    for _r in obs.flight.last():
+        if _r.get("kind") == "stall" and isinstance(
+            _r.get("idle_s"), (int, float)
+        ):
+            gp_meter.add_seconds("stall", _r["idle_s"])
+    try:
+        gp_mesh = {
+            str(ax): int(s) for ax, s in zip(
+                meta["mesh"].axis_names, meta["mesh"].devices.shape
+            )
+        }
+    except Exception:  # noqa: BLE001 — identity only
+        gp_mesh = {}
+    attempt_goodput = gp_meter.finalize(
+        scope="train_attempt", strategy=meta["layout"], mesh=gp_mesh,
+    )
+    telemetry["goodput"] = goodput_mod.goodput_cell(attempt_goodput)
+    if args.obs_dir:
+        goodput_mod.write_run_goodput(attempt_goodput, args.obs_dir)
+    if own_lineage:
+        try:
+            from ddl25spring_tpu.obs import perfscope
+
+            telemetry["goodput"]["ledger"] = perfscope.append_ledger(
+                goodput_mod.ledger_row(
+                    attempt_goodput, strategy=meta["layout"],
+                    mesh=gp_mesh, host=perfscope.host_fingerprint(),
+                ),
+                args.perf_ledger or perfscope.DEFAULT_LEDGER,
+            )
+        except OSError as e:  # a read-only FS must not kill the line
+            telemetry["goodput"]["ledger_error"] = str(e)
 
     primary_mode = (
         f"{ds.input_mode}-scan{K}" if multi is not None else ds.input_mode

@@ -462,6 +462,7 @@ def timed_run(
     steps_per_call: int = 1,
     on_step=None,
     step_offset: int = 0,
+    goodput=None,
 ):
     """Warmup (compile) then time ``steps`` calls; returns ``(dt, params,
     opt_state)``.  Forces completion via a host transfer — on this image's
@@ -496,23 +497,38 @@ def timed_run(
     gate needs) — the same cost the logger path already pays.
     ``step_offset`` also shifts the flight/logger step indices so a
     resumed run's records continue where the dead run's stopped.
+
+    ``goodput`` (an :class:`~ddl25spring_tpu.obs.goodput.GoodputMeter`)
+    bills the warmup/compile bracket and each timed dispatch into the
+    run's badput decomposition — the same perf-counter reads the
+    timing already takes, re-expressed on the meter's axis, so the
+    measurement itself is unchanged.
     """
     from ddl25spring_tpu import obs
 
     loss = None
+    w0 = goodput.now() if goodput is not None else 0.0
     with obs.span("warmup", label=label, n=warmup):
         for _ in range(warmup):
             params, opt_state, loss = step(params, opt_state, feed())
             obs.flight.beat()
         if loss is not None:
             float(loss)
+    if goodput is not None and warmup > 0:
+        goodput.add("warmup_compile", w0, goodput.now(), label=label)
     if logger is None and on_step is None:
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, loss = step(params, opt_state, feed())
             obs.flight.beat()
         float(loss)  # the step chain is data-dependent through params
-        return time.perf_counter() - t0, params, opt_state
+        dt = time.perf_counter() - t0
+        if goodput is not None and steps > 0:
+            # one bulk window: the fast path has no per-step walls
+            g1 = goodput.now()
+            goodput.add("useful_step", g1 - dt, g1, label=label,
+                        steps=steps)
+        return dt, params, opt_state
 
     total = 0.0
     with obs.span("timed_run", label=label, steps=steps):
@@ -524,6 +540,10 @@ def timed_run(
                 lval = float(loss)  # force completion per call
             wall = time.perf_counter() - prev
             total += wall
+            if goodput is not None:
+                g1 = goodput.now()
+                goodput.note_step(gi, g1 - wall, g1,
+                                  resumable=on_step is not None)
             obs.flight.record(
                 kind="step", strategy=label, step=gi,
                 wall_s=round(wall, 6), loss=lval,

@@ -376,6 +376,22 @@ def summarize_run(run_dir: str) -> dict[str, Any]:
         except (json.JSONDecodeError, OSError) as e:
             out["mem"] = {"error": f"unreadable {MEM_BASENAME}: {e}"}
 
+    # goodput decomposition, when a graft-goodput run/lineage dropped
+    # one here (ddl25spring_tpu/obs/goodput.py): the badput taxonomy,
+    # the sum-to-wall contract, and — for serve scopes — SLO attainment
+    # and availability; trend/gate with tools/goodput_report.py
+    from ddl25spring_tpu.obs.goodput import (
+        GOODPUT_BASENAME,
+        read_run_goodput,
+    )
+
+    if os.path.exists(os.path.join(run_dir, GOODPUT_BASENAME)):
+        gp = read_run_goodput(run_dir)
+        out["goodput"] = (
+            gp if isinstance(gp, dict) and gp.get("record") == "goodput"
+            else {"error": f"unreadable {GOODPUT_BASENAME}"}
+        )
+
     # compile-time analytics, when a bench/CLI run dropped its report here
     # (ddl25spring_tpu/obs/compile_report.py) — measured p50/p95 above,
     # compiled collectives/HBM/MFU-projection below, one run dir
@@ -730,6 +746,64 @@ def format_report(summary: dict[str, Any]) -> str:
                     if mem.get("reshape_steps") is not None else ""
                 )
             )
+
+    gp = summary.get("goodput")
+    if gp:
+        lines.append("")
+        lines.append(
+            "goodput (goodput.json — graft-goodput lineage "
+            "decomposition; trend/gate with tools/goodput_report.py):"
+        )
+        if gp.get("error"):
+            lines.append(f"  {gp['error']}")
+        else:
+            total = gp.get("total_wall_s")
+            fu = gp.get("fraction_useful")
+            lines.append(
+                f"  scope {gp.get('scope')}  lineage "
+                f"{gp.get('lineage_id')}  attempts "
+                f"{gp.get('attempts') or gp.get('attempt') or 1}  "
+                f"chips {gp.get('chips')}  wall "
+                + (f"{total:.3f} s" if isinstance(total, (int, float))
+                   else "n/a")
+            )
+            seconds = gp.get("seconds") or {}
+            if seconds and isinstance(total, (int, float)) and total > 0:
+                for bucket, secs in sorted(
+                        seconds.items(), key=lambda kv: -kv[1]):
+                    if not secs:
+                        continue
+                    lines.append(
+                        f"  {bucket:<18} {secs:>9.3f} s "
+                        f"({secs / total * 100:5.1f}%)"
+                    )
+            sc = gp.get("sum_check") or {}
+            lines.append(
+                "  fraction useful "
+                + (f"{fu:.4f}" if isinstance(fu, (int, float))
+                   else "n/a")
+                + f"  replayed steps {gp.get('replayed_steps_count', 0)}"
+                + f"  sum-to-wall ok: {sc.get('ok')}"
+            )
+            if gp.get("scope") == "serve":
+                att = gp.get("slo_attainment")
+                avail = gp.get("availability")
+                gtps = gp.get("goodput_tokens_per_sec_per_chip")
+                slo = gp.get("slo") or {}
+                lines.append(
+                    "  SLO attainment "
+                    + (f"{att * 100:.1f}%" if isinstance(
+                        att, (int, float)) else "n/a")
+                    + (f" (TTFT<={slo.get('ttft_ms')}ms, "
+                       f"tok<={slo.get('tok_ms')}ms, "
+                       f"{slo.get('clock')} clock)" if slo else "")
+                    + "  availability "
+                    + (f"{avail * 100:.1f}%" if isinstance(
+                        avail, (int, float)) else "n/a")
+                    + "  goodput tok/s/chip "
+                    + (f"{gtps:.2f}" if isinstance(
+                        gtps, (int, float)) else "n/a")
+                )
 
     c = summary.get("counters", {})
     statics = c.get("static", {})

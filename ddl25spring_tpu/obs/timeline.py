@@ -127,12 +127,17 @@ class Timeline:
     # ------------------------------------------------------- lifecycle
 
     def configure(self, run_dir: str | None = None,
-                  capacity: int | None = None) -> None:
+                  capacity: int | None = None,
+                  meta: dict | None = None) -> None:
         """(Re)target the timeline at a run directory.  Opens a fresh
         ``timeline.jsonl`` (header line first), resets seq/ring/clock
         origin — one configure == one run — and registers the crash
         flush with the flight shutdown chain.  ``run_dir=None`` closes
-        the stream (events still ring in memory)."""
+        the stream (events still ring in memory).  ``meta`` merges
+        extra identity fields into the header line (bench stamps the
+        retry ``lineage_id`` / ``attempt`` here so every attempt's
+        timeline names the lineage it belongs to); reserved header
+        keys win over collisions."""
         with self._lock:
             self.close()
             if capacity is not None:
@@ -148,6 +153,7 @@ class Timeline:
             self.path = os.path.join(run_dir, TIMELINE_BASENAME)
             self._stream = open(self.path, "w")
             header = {
+                **(meta or {}),
                 "record": "timeline_header",
                 "time_origin_unix_s": self._t0_unix,
                 "capacity": self._ring.maxlen,

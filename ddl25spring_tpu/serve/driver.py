@@ -713,7 +713,23 @@ def elastic_serve_run(
     rejected = sum(
         sum(e.rejected.values()) for e in [*reps, *retired]
     )
+    # graft-goodput (PR 20): SLO attainment on the DRIVER's virtual
+    # clock — the elastic arm is deterministic, so this attainment
+    # number reproduces bit-for-bit on any host (exactly where wall
+    # would be noise-bound).  Drain-window demand = the handoff
+    # re-submissions: served capacity the reshape consumed twice,
+    # charged against availability even though zero requests dropped.
+    from ddl25spring_tpu.obs import goodput as goodput_mod
+
+    drain_demand = sum(int(ev.get("requeued") or 0) for ev in events)
+    slo_goodput = goodput_mod.serve_goodput_cell(
+        all_done, clock="virtual", wall_s=t if t > 0 else None,
+        n_chips=replicas, offered=submitted, rejected=rejected,
+        completed=completed, dropped=max(0, admitted - completed),
+        drain_demand=drain_demand,
+    )
     return {
+        "goodput": slo_goodput,
         "events": events,
         "tick_s": tick_s,
         "iters": it,
@@ -762,10 +778,13 @@ def run_serve_bench(
     skip_spec_ab: bool = False,
     skip_tp_ab: bool = False,
     serve_tp: int | None = None,
+    lineage: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """The whole serving bench; returns the BENCH record (one JSON line
     with ``telemetry.serve``).  ``budget_s`` bounds the wall-clock ramp
-    phase (None = run to drain)."""
+    phase (None = run to drain).  ``lineage`` (bench's
+    ``{"lineage_id", "attempt"}``) stamps the run's goodput doc and
+    ledger row with the retry-lineage identity."""
     import jax
 
     from ddl25spring_tpu.models import llama
@@ -1014,6 +1033,48 @@ def run_serve_bench(
         "bench_wall_s": round(time.perf_counter() - t_start, 3),
         **({"mem": mem} if mem is not None else {}),
     }
+
+    # --- graft-goodput (PR 20): the SLO-denominated serving verdict ----
+    # The ramp is judged on its own clock (wall — it is the measured
+    # phase); the elastic arm's cell (virtual clock, reproducible on
+    # any host) rides as ``elastic`` when chaos armed replica
+    # reshaping.  goodput.json + the record:"goodput" ledger row are
+    # what serve smokes gate SLO attainment on.
+    from ddl25spring_tpu.obs import goodput as goodput_mod
+
+    slo = goodput_mod.serve_slo()
+    record["goodput"] = {
+        "record": "goodput",
+        "scope": "serve",
+        **(lineage or {}),
+        "chips": ramp.get("n_chips") or 1,
+        "total_wall_s": ramp.get("wall_s"),
+        **goodput_mod.serve_goodput_cell(
+            eng.done, clock=eng.clock, wall_s=ramp.get("wall_s"),
+            n_chips=ramp.get("n_chips") or 1,
+            offered=int(ramp.get("admitted") or 0)
+            + int(ramp.get("rejected") or 0),
+            rejected=int(ramp.get("rejected") or 0),
+            completed=int(ramp.get("completed") or 0),
+            # a budget-cut ramp still holds live slots: their requests
+            # are in flight, not dropped — only a drained ramp may call
+            # the admitted-minus-completed gap a drop
+            dropped=(
+                max(
+                    0,
+                    int(ramp.get("admitted") or 0)
+                    - int(ramp.get("completed") or 0),
+                )
+                if eng.drained else 0
+            ),
+            slo=slo,
+        ),
+        **(
+            {"elastic": reshape["goodput"]}
+            if reshape is not None and reshape.get("goodput") else {}
+        ),
+    }
+
     if obs_dir:
         os.makedirs(obs_dir, exist_ok=True)
         path = os.path.join(obs_dir, SERVE_BASENAME)
@@ -1024,6 +1085,9 @@ def run_serve_bench(
         record["serve_json"] = path
         if mem is not None:  # mem.json rides next to serve.json
             record["mem_json"] = memscope.write_run_mem(mem, obs_dir)
+        record["goodput_json"] = goodput_mod.write_run_goodput(
+            record["goodput"], obs_dir
+        )
     if ledger_path is not None:
         from ddl25spring_tpu.obs.perfscope import append_ledger
 
@@ -1033,6 +1097,20 @@ def run_serve_bench(
             )
             if mem is not None:  # the record:"mem" trend row
                 append_ledger(mem, ledger_path)
+            append_ledger(  # the record:"goodput" trend row
+                goodput_mod.ledger_row(
+                    record["goodput"],
+                    strategy=f"serve/{model}",
+                    mesh={
+                        "replicas": 1,
+                        **({"tp": eng.tp} if eng.tp > 1 else {}),
+                    },
+                    host=record["host"],
+                    git_sha=record["git_sha"],
+                    extra_key={"profile": spec.profile},
+                ),
+                ledger_path,
+            )
         except OSError as e:  # a read-only FS must not kill the line
             record["ledger_error"] = str(e)
     return record

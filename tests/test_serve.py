@@ -530,10 +530,18 @@ def test_driver_emits_the_telemetry_serve_contract(smoke_record):
     assert doc["record"] == "serve" and doc["ramp"]["admitted"] > 0
     rows = [json.loads(line)
             for line in open(led) if line.strip()]
-    assert len(rows) == 1 and rows[0]["record"] == "serve"
-    assert rows[0]["ab"]["advantage_tokens"] > 0
+    # PR 20: the driver also appends a record:"goodput" ledger row —
+    # exactly one serve row and one goodput row per run
+    by_rec = {}
+    for r in rows:
+        by_rec.setdefault(r["record"], []).append(r)
+    assert sorted(by_rec) == ["goodput", "serve"]
+    assert len(by_rec["serve"]) == 1 and len(by_rec["goodput"]) == 1
+    serve_row = by_rec["serve"][0]
+    assert serve_row["ab"]["advantage_tokens"] > 0
+    assert by_rec["goodput"][0]["key"]["scope"] == "serve"
     # raw sample lists stay OUT of the ledger (stdlib tool, 1 line/run)
-    assert "ttft_s" not in rows[0] and "tick_wall_s" not in rows[0]
+    assert "ttft_s" not in serve_row and "tick_wall_s" not in serve_row
 
 
 def test_serve_report_renders_and_checks(smoke_record, capsys):

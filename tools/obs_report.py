@@ -36,6 +36,11 @@ exit codes:
   4  --check-health: memory violation — mem.json records leaked KV
      pages, windowed monotone live-bytes growth, or a budget-band
      breach (graft-mem; see tools/mem_report.py for the full gate)
+  5  --check-health: goodput/SLO violation — goodput.json's bucket
+     decomposition breaks its sum-to-wall contract, or (with
+     --slo-floor) a serve-scope record's SLO attainment sits below
+     the floor (graft-goodput; see tools/goodput_report.py for the
+     cross-run trend gate)
 """
 
 
@@ -53,6 +58,11 @@ def main(argv=None) -> int:
                     help="exit non-zero when the run's flight.json "
                          "records sentinel violations or a stall (the "
                          "CI health gate)")
+    ap.add_argument("--slo-floor", type=float, default=None,
+                    metavar="FRACTION",
+                    help="with --check-health: also fail (exit 5) when "
+                         "a serve-scope goodput.json reports SLO "
+                         "attainment below this fraction (0..1)")
     args = ap.parse_args(argv)
 
     try:
@@ -117,6 +127,33 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 4
+        gp = summary.get("goodput") or {}
+        gp_problems = []
+        if gp and not gp.get("error"):
+            sc = gp.get("sum_check") or {}
+            if sc.get("ok") is False:
+                gp_problems.append(
+                    f"decomposition breaks the sum-to-wall contract "
+                    f"(attributed {sc.get('attributed_s')} s vs wall "
+                    f"{sc.get('total_wall_s')} s, tol "
+                    f"{sc.get('tolerance')})"
+                )
+            att = gp.get("slo_attainment")
+            if (args.slo_floor is not None
+                    and gp.get("scope") == "serve"
+                    and (not isinstance(att, (int, float))
+                         or att < args.slo_floor)):
+                gp_problems.append(
+                    f"SLO attainment {att} below floor "
+                    f"{args.slo_floor}"
+                )
+        if gp_problems:
+            print(
+                f"goodput check FAILED for {args.run_dir}: "
+                + "; ".join(gp_problems),
+                file=sys.stderr,
+            )
+            return 5
         print(f"health check ok for {args.run_dir}", file=sys.stderr)
     return 0
 

@@ -16,6 +16,11 @@ Inputs (all from the run directory; only the timeline is required):
   instants on a "flight ring" track (needs the recorder's
   ``time_origin_unix_s``, present from PR 16 on — older dumps are
   skipped with a note).
+- ``goodput.json``    — the graft-goodput decomposition
+  (``obs/goodput.py``): each badput window becomes a complete ("X")
+  span on a per-lineage goodput track, one thread row per bucket, so
+  warmup / checkpoint / replay / reshape time lines up under the
+  request spans and subsystem tracks it explains.
 
 Output: ``trace_merged.json`` (Chrome JSON object format — open in
 https://ui.perfetto.dev or ``chrome://tracing``) with
@@ -37,7 +42,12 @@ https://ui.perfetto.dev or ``chrome://tracing``) with
 complete — no orphan ``serve_admit`` without a terminal ``serve_done``
 (a drain-handoff is an intermediate leg: the request must still admit
 and finish on a survivor).  Submitted-but-never-seated requests (run
-ended mid-queue under a wall budget) are reported, not failed.
+ended mid-queue under a wall budget) are reported, not failed.  When
+the run carries a ``goodput.json``, ``--check`` also refuses a goodput
+section whose windows overlap one another, run past the lineage's
+total wall, or whose bucket seconds sum past total wall beyond the
+pinned tolerance — an overlapping decomposition double-bills chip
+time, which is exactly the lie goodput exists to prevent.
 
 Everything here is stdlib-only, like the other report tools: the gate
 must run anywhere CI can run python.
@@ -53,13 +63,22 @@ import sys
 TIMELINE_BASENAME = "timeline.jsonl"  # restated from obs/timeline.py
 TRACE_BASENAME = "trace.json"         # restated from obs/spans.py usage
 FLIGHT_BASENAME = "flight.json"       # restated from obs/recorder.py
+GOODPUT_BASENAME = "goodput.json"     # restated from obs/goodput.py
 MERGED_BASENAME = "trace_merged.json"
+
+# restated from obs/goodput.py SUM_TOLERANCE: bucket seconds may sum
+# past total wall by at most this fraction before --check refuses
+GOODPUT_SUM_TOLERANCE = 0.02
+# two goodput windows may touch within this slack (float accumulation
+# across a multi-attempt lineage) without counting as an overlap
+GOODPUT_OVERLAP_SLACK_S = 1e-3
 
 # synthetic pids, far above any real os.getpid() the span recorder
 # stamped, so the merged view never interleaves two unrelated tracks
 PID_SUBSYS = 1_000_000
 PID_FLIGHT = 1_000_001
 PID_COUNTERS = 1_000_002  # graft-mem resource counter tracks (ph=C)
+PID_GOODPUT = 1_000_003   # graft-goodput per-lineage badput windows
 PID_REPLICA0 = 1_000_100  # + stable replica ordinal per serve track
 
 # mem_sample fields that become Perfetto counter tracks ("ph":"C"),
@@ -193,6 +212,75 @@ def check_chains(events: list[dict]) -> tuple[list[str], dict]:
         "drain_handoffs": handoffs,
     }
     return fails, stats
+
+
+def read_goodput(run_dir: str) -> dict | None:
+    """The run's goodput.json decomposition, or None when absent /
+    unparseable (older runs predate graft-goodput; that is a note,
+    not a failure)."""
+    path = os.path.join(run_dir, GOODPUT_BASENAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("record") != "goodput":
+        return None
+    return doc
+
+
+def check_goodput(doc: dict) -> list[str]:
+    """The goodput leg of --check.  A decomposition is a partition of
+    the lineage's wall clock: windows must not overlap each other, must
+    not run past total wall, and bucket seconds must not sum past total
+    wall beyond GOODPUT_SUM_TOLERANCE."""
+    fails: list[str] = []
+    total = doc.get("total_wall_s")
+    windows = [
+        w for w in (doc.get("windows") or [])
+        if isinstance(w, dict)
+        and isinstance(w.get("t0_s"), (int, float))
+        and isinstance(w.get("t1_s"), (int, float))
+    ]
+    by_start = sorted(windows, key=lambda w: (w["t0_s"], w["t1_s"]))
+    for prev, cur in zip(by_start, by_start[1:]):
+        if cur["t0_s"] < prev["t1_s"] - GOODPUT_OVERLAP_SLACK_S:
+            fails.append(
+                f"windows overlap: {prev.get('bucket')}"
+                f"[{prev['t0_s']:.3f},{prev['t1_s']:.3f}] vs "
+                f"{cur.get('bucket')}"
+                f"[{cur['t0_s']:.3f},{cur['t1_s']:.3f}] — the "
+                "decomposition double-bills that interval"
+            )
+    if isinstance(total, (int, float)):
+        for w in by_start:
+            if w["t1_s"] > total + GOODPUT_OVERLAP_SLACK_S:
+                fails.append(
+                    f"window {w.get('bucket')}"
+                    f"[{w['t0_s']:.3f},{w['t1_s']:.3f}] runs past "
+                    f"total wall {total:.3f}s"
+                )
+            if w["t0_s"] < -GOODPUT_OVERLAP_SLACK_S:
+                fails.append(
+                    f"window {w.get('bucket')} starts before the "
+                    f"lineage origin (t0={w['t0_s']:.3f}s)"
+                )
+        seconds = doc.get("seconds") or {}
+        attributed = sum(
+            v for v in seconds.values() if isinstance(v, (int, float)))
+        if attributed > total * (1.0 + GOODPUT_SUM_TOLERANCE) + 1e-9:
+            fails.append(
+                f"bucket seconds sum to {attributed:.3f}s > total wall "
+                f"{total:.3f}s beyond the {GOODPUT_SUM_TOLERANCE:.0%} "
+                "tolerance"
+            )
+    sc = doc.get("sum_check")
+    if isinstance(sc, dict) and sc.get("ok") is False:
+        fails.append(
+            f"goodput's own sum_check is marked failed: {sc}")
+    return fails
 
 
 def merge(run_dir: str) -> tuple[dict, dict]:
@@ -337,6 +425,40 @@ def merge(run_dir: str) -> tuple[dict, dict]:
                         "args": {field: ev[field]}})
     notes["counter_tracks"] = len(counter_names)
 
+    # ---- goodput badput windows (obs/goodput.py goodput.json) ------
+    notes["goodput_windows"] = 0
+    gdoc = read_goodput(run_dir)
+    if gdoc is not None:
+        gp_origin = gdoc.get("time_origin_unix_s")
+        if gp_origin is None:
+            notes["goodput_note"] = (
+                f"{GOODPUT_BASENAME} carries no time_origin_unix_s; "
+                "windows not merged")
+        else:
+            shift = (gp_origin - t0_unix) * 1e6
+            lineage = gdoc.get("lineage_id") or "?"
+            title = f"goodput [lineage {lineage}]"
+            meta(PID_GOODPUT, title)
+            gp_tids: dict[str, int] = {}
+            for w in gdoc.get("windows") or []:
+                if not (isinstance(w, dict)
+                        and isinstance(w.get("t0_s"), (int, float))
+                        and isinstance(w.get("t1_s"), (int, float))):
+                    continue
+                bucket = str(w.get("bucket", "other"))
+                if bucket not in gp_tids:
+                    gp_tids[bucket] = len(gp_tids) + 1
+                    meta(PID_GOODPUT, title, gp_tids[bucket], bucket)
+                out.append({
+                    "pid": PID_GOODPUT, "tid": gp_tids[bucket],
+                    "ph": "X", "cat": "goodput", "name": bucket,
+                    "ts": w["t0_s"] * 1e6 + shift,
+                    "dur": max((w["t1_s"] - w["t0_s"]) * 1e6, 1),
+                    "args": {k: v for k, v in w.items()
+                             if k not in ("t0_s", "t1_s")},
+                })
+                notes["goodput_windows"] += 1
+
     # ---- host spans (obs/spans.py trace.json) ----------------------
     span_path = os.path.join(run_dir, TRACE_BASENAME)
     notes["host_spans"] = 0
@@ -428,7 +550,8 @@ def main(argv=None) -> int:
         f"merged {notes['timeline_events']} timeline event(s), "
         f"{notes['host_spans']} host span event(s), "
         f"{notes['flight_records']} flight record(s), "
-        f"{notes['counter_tracks']} counter track(s) -> {out_path}"
+        f"{notes['counter_tracks']} counter track(s), "
+        f"{notes['goodput_windows']} goodput window(s) -> {out_path}"
     )
     print(
         f"requests: {stats['requests']} traced, {stats['admitted']} "
@@ -436,7 +559,7 @@ def main(argv=None) -> int:
         f"rejected, {stats['pending']} pending, "
         f"{stats['drain_handoffs']} drain-handoff(s)"
     )
-    for note in ("host_spans_note", "flight_note"):
+    for note in ("host_spans_note", "flight_note", "goodput_note"):
         if notes.get(note):
             print(f"note: {notes[note]}", file=sys.stderr)
     if args.check:
@@ -444,6 +567,14 @@ def main(argv=None) -> int:
             for f_ in fails:
                 print(f"span-chain check FAILED: {f_}", file=sys.stderr)
             return 1
+        gdoc = read_goodput(args.run_dir)
+        if gdoc is not None:
+            gp_fails = check_goodput(gdoc)
+            if gp_fails:
+                for f_ in gp_fails:
+                    print(f"goodput check FAILED: {f_}",
+                          file=sys.stderr)
+                return 1
         if notes["counter_tracks"] < args.min_counter_tracks:
             print(
                 f"counter-track check FAILED: {notes['counter_tracks']}"
@@ -452,7 +583,9 @@ def main(argv=None) -> int:
                 f"check DDL25_MEMSCOPE)", file=sys.stderr)
             return 1
         print("span-chain check ok: every admitted request reached "
-              "a terminal serve_done", file=sys.stderr)
+              "a terminal serve_done; goodput windows "
+              + ("partition total wall" if gdoc is not None
+                 else "absent (no goodput.json)"), file=sys.stderr)
     return 0
 
 
